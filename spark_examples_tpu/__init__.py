@@ -1,0 +1,28 @@
+"""spark_examples_tpu — a TPU-native population-genomics analysis framework.
+
+A from-scratch rebuild of the capability surface of
+``StanfordBioinformatics/spark-examples`` (a Scala/Apache-Spark genomics
+example stack: Genomics-API/BigQuery variant ingest → pairwise
+similarity/IBS distance matrices → double-centering → eigendecomposition →
+PCA/PCoA coordinates), re-designed TPU-first:
+
+- the dense linear-algebra core (similarity/Gram accumulation, centering,
+  symmetric eigendecomposition) is expressed as JAX/XLA programs, blocked
+  for the MXU and sharded over a ``jax.sharding.Mesh`` via ``shard_map`` /
+  ``jit`` — replacing the reference's Spark ``reduceByKey`` shuffle and
+  MLlib ``RowMatrix`` path (reference call stack: SURVEY.md §3.1);
+- the ingest layer keeps the reference's partitioned-streaming shape
+  (``VariantsRDD`` + genomic-range partitioners, SURVEY.md §2.1) behind a
+  :class:`~spark_examples_tpu.ingest.source.GenotypeSource` protocol;
+- job entrypoints mirror the reference's driver surface
+  (``VariantsPcaDriver``, ``SimilarityMatrix``, ``PCoA``,
+  ``SearchVariantsExample*``) as CLI subcommands.
+
+NOTE ON CITATIONS: the reference mount (``/root/reference``) contained zero
+files in every session so far; reference citations in this package point to
+SURVEY.md sections (the reconstruction of record) rather than file:line.
+"""
+
+from spark_examples_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,1 @@
+from spark_examples_tpu.core import config, dtypes, meshes, profiling  # noqa: F401

@@ -1,0 +1,78 @@
+"""Job configuration — the Scallop ``GenomicsConf`` / ``PcaConf`` analogue.
+
+The reference parsed driver flags with Scallop ``ScallopConf`` subclasses:
+``--references chr:start:end``, ``--variant-set-id``, ``--output-path``,
+``--num-reduce-partitions``, ``--client-secrets``, ``--spark-master``
+(SURVEY.md §2.1 "CLI/config", §5 "Config / flag system"). Here the same
+semantics live in plain dataclasses, constructed either directly or from
+the CLI (``spark_examples_tpu.cli``). The mandated backend gate
+``--backend={spark-mllib|jax-tpu}`` appears as
+``backend={cpu-reference|jax-tpu}`` — the NumPy/SciPy oracle stands in for
+the Spark MLlib baseline in this Spark-less environment (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ReferenceRange:
+    """A genomic range ``contig:start:end`` — the unit the reference's
+    ``VariantsPartitioner`` split into RDD partitions (SURVEY.md §2.1
+    "Genomic-range partitioners")."""
+
+    contig: str
+    start: int
+    end: int
+
+    @classmethod
+    def parse(cls, spec: str) -> "ReferenceRange":
+        contig, start, end = spec.split(":")
+        return cls(contig, int(start), int(end))
+
+    def __str__(self) -> str:
+        return f"{self.contig}:{self.start}:{self.end}"
+
+
+@dataclass
+class IngestConfig:
+    """Which variants to stream, from where, in what block shape."""
+
+    source: str = "synthetic"  # synthetic | vcf | packed
+    path: str | None = None  # file path for vcf/packed sources
+    references: list[ReferenceRange] = field(default_factory=list)
+    n_samples: int = 2504  # synthetic default: 1000 Genomes phase-3 cohort
+    n_variants: int = 100_000  # synthetic default
+    block_variants: int = 8192  # variants per streamed block (v_blk)
+    seed: int = 0  # synthetic source seed
+    n_populations: int = 5  # synthetic ancestry clusters
+
+
+@dataclass
+class ComputeConfig:
+    """Compute-path knobs."""
+
+    backend: str = "jax-tpu"  # jax-tpu | cpu-reference
+    # Gram-path metrics: ibs | ibs2 | shared-alt | grm | euclidean | dot
+    # (streamed genotype blocks). "braycurtis" is valid at the pipeline
+    # level only — it dispatches to the dense-table distances.braycurtis
+    # path, not the gram accumulator.
+    metric: str = "ibs"
+    num_pc: int = 10
+    mesh_shape: tuple[int, int] | None = None  # None -> auto-factor devices
+    gram_mode: str = "auto"  # auto | replicated | variant | tile2d
+    eigh_mode: str = "auto"  # auto | dense | randomized
+    checkpoint_dir: str | None = None
+    checkpoint_every_blocks: int = 0  # 0 disables partial-Gram checkpoints
+
+
+@dataclass
+class JobConfig:
+    ingest: IngestConfig = field(default_factory=IngestConfig)
+    compute: ComputeConfig = field(default_factory=ComputeConfig)
+    output_path: str | None = None
+
+    def replace(self, **kw) -> "JobConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,52 @@
+"""Genotype dtype policy and encodings.
+
+The unit of data movement everywhere in this framework is the *genotype
+block*: an ``(n_samples, block_variants)`` array of alt-allele dosages
+
+    0, 1, 2  — number of alternate alleles carried by the sample
+    -1       — missing / no-call
+
+stored as ``int8`` on host and device (HBM bandwidth is the usual
+bottleneck; int8 blocks are 4x smaller than f32). Compute promotes to
+``bfloat16``/``float32`` only inside the matmul kernels, mirroring the
+"int8 dosage packed N x v_blk; promote in-register for FMA" policy from
+SURVEY.md §7 step 1.
+
+The reference kept variants as Scala case classes of per-call genotype
+lists shuffled through Spark (SURVEY.md §2.1 "Serializable data model");
+the dense dosage block is this framework's replacement for that model on
+the compute path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Host/device storage dtype for genotype dosage blocks.
+GENOTYPE_DTYPE = np.int8
+# Accumulator dtype for N x N similarity/Gram accumulators.
+ACCUM_DTYPE = jnp.float32
+# Matmul input dtype (MXU-native).
+COMPUTE_DTYPE = jnp.bfloat16
+
+MISSING = -1  # sentinel dosage for a missing genotype call
+
+# Alignment for block shapes: v5e MXU tiles are 128x128 (f32/bf16 lane
+# width 128, sublane 8); padding sample and variant block dims to 128
+# keeps XLA from emitting ragged tiles.
+LANE = 128
+
+
+def round_up(n: int, multiple: int = LANE) -> int:
+    """Round ``n`` up to a multiple (for MXU-friendly padding)."""
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def validate_genotypes(block: np.ndarray) -> None:
+    """Cheap host-side sanity check on an ingest block."""
+    if block.dtype != GENOTYPE_DTYPE:
+        raise TypeError(f"genotype block must be int8, got {block.dtype}")
+    lo, hi = int(block.min()), int(block.max())
+    if lo < MISSING or hi > 2:
+        raise ValueError(f"genotype values out of range [-1, 2]: [{lo}, {hi}]")

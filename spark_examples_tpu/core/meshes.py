@@ -1,0 +1,110 @@
+"""Device-mesh bootstrap: the execution substrate of the framework.
+
+The reference's execution substrate was Apache Spark (SURVEY.md §1 L1): a
+driver JVM scheduling RDD partitions onto executors, with netty shuffle as
+the communication backend. Here the substrate is a
+:class:`jax.sharding.Mesh` over a TPU slice; communication is the XLA
+collectives (``psum`` / ``all_gather`` / ``reduce_scatter`` /
+``ppermute``) that ``jit``/``shard_map`` emit over ICI, with
+``jax.distributed`` for multi-host (DCN) coordination (SURVEY.md §2.2
+"Distributed communication backend").
+
+Mesh axes
+---------
+``("i", "j")`` — a 2-D mesh over which the N x N similarity / Gram
+accumulator is tiled (rows over ``i``, columns over ``j``). The 40M-long
+*variant* axis — the reference's only parallel axis (RDD partitions by
+genomic range) — is streamed in blocks and, in the variant-parallel mode,
+sharded over the flattened ``(i, j)`` device list with a final ``psum``
+(the TPU-native replacement of Spark's ``reduceByKey`` shuffle).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_I = "i"  # sample-row axis of the N x N accumulator
+AXIS_J = "j"  # sample-column axis of the N x N accumulator
+
+
+_distributed_initialized = False
+
+
+def maybe_init_distributed() -> None:
+    """Initialise ``jax.distributed`` when launched multi-host.
+
+    Single-host runs (this environment) skip it; multi-host launchers set
+    ``JAX_COORDINATOR_ADDRESS`` (plus process id/count env vars). Must run
+    before any JAX backend is touched — so this deliberately avoids
+    querying ``jax.process_count()``/``jax.devices()`` first. Mirrors the
+    role of the reference's SparkContext connect (SURVEY.md §3.1) minus
+    the driver/executor split.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+    _distributed_initialized = True
+
+
+def _factor_2d(n: int) -> tuple[int, int]:
+    """Near-square factorization of a device count into (i, j)."""
+    best = (1, n)
+    for i in range(1, int(math.isqrt(n)) + 1):
+        if n % i == 0:
+            best = (i, n // i)
+    return best
+
+
+def make_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    shape: tuple[int, int] | None = None,
+) -> Mesh:
+    """Build the framework's 2-D ``(i, j)`` mesh.
+
+    ``shape`` defaults to a near-square factorization of the device count,
+    e.g. 8 devices -> (2, 4). A single device yields a (1, 1) mesh so all
+    sharded code paths also run unmodified on one chip.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = _factor_2d(n)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, (AXIS_I, AXIS_J))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def tile2d(mesh: Mesh) -> NamedSharding:
+    """Sharding for the N x N accumulator: rows over i, cols over j."""
+    return NamedSharding(mesh, P(AXIS_I, AXIS_J))
+
+
+def rows_i(mesh: Mesh) -> NamedSharding:
+    """Sharding for an (N, V) genotype block: sample rows over i."""
+    return NamedSharding(mesh, P(AXIS_I, None))
+
+
+def rows_j(mesh: Mesh) -> NamedSharding:
+    """Sharding for an (N, V) genotype block: sample rows over j."""
+    return NamedSharding(mesh, P(AXIS_J, None))
+
+
+def variants_flat(mesh: Mesh) -> NamedSharding:
+    """Sharding for an (N, V) block with the variant axis split over the
+    whole mesh — the data-parallel axis (reference: RDD partitions by
+    genomic range, SURVEY.md §2.2)."""
+    return NamedSharding(mesh, P(None, (AXIS_I, AXIS_J)))

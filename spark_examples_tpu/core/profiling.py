@@ -1,0 +1,80 @@
+"""Per-phase timing and profiling.
+
+The reference had no in-repo tracing; it leaned on the Spark web UI and
+stage/task metrics (SURVEY.md §5 "Tracing / profiling"). The TPU-native
+replacement is (a) a phase-timer that blocks on device results so
+wall-clock numbers are honest, emitting the structured per-phase metrics
+the baseline asks for (ingest MB/s, Gram GFLOPS, eigh GFLOPS/chip —
+BASELINE.md), and (b) optional ``jax.profiler`` trace capture viewable in
+TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+@dataclass
+class PhaseTimer:
+    """Accumulates named phase durations; durations are wall-clock with
+    ``block_until_ready`` applied to whatever the phase returns."""
+
+    phases: dict[str, float] = field(default_factory=dict)
+    counters: dict[str, float] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def timed(self, name: str, fn, *args, **kwargs):
+        with self.phase(name):
+            out = fn(*args, **kwargs)
+            out = jax.block_until_ready(out)
+        return out
+
+    def add(self, counter: str, amount: float) -> None:
+        self.counters[counter] = self.counters.get(counter, 0.0) + amount
+
+    def report(self) -> dict:
+        rep: dict[str, float] = dict(self.phases)
+        # Derived throughput metrics where the raw counters exist.
+        if "gram_flops" in self.counters and self.phases.get("gram"):
+            rep["gram_gflops_per_s"] = (
+                self.counters["gram_flops"] / self.phases["gram"] / 1e9
+            )
+        if "ingest_bytes" in self.counters and self.phases.get("ingest"):
+            rep["ingest_mb_per_s"] = (
+                self.counters["ingest_bytes"] / self.phases["ingest"] / 1e6
+            )
+        if "eigh_flops" in self.counters and self.phases.get("eigh"):
+            rep["eigh_gflops_per_s"] = (
+                self.counters["eigh_flops"] / self.phases["eigh"] / 1e9
+            )
+        return rep
+
+    def dump(self) -> str:
+        return json.dumps(self.report(), sort_keys=True)
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None):
+    """Capture a ``jax.profiler`` trace into ``logdir`` when set."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

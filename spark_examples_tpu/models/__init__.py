@@ -1,0 +1,1 @@
+from spark_examples_tpu.models import pca, pcoa  # noqa: F401

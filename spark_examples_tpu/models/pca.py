@@ -1,0 +1,49 @@
+"""PCA on the similarity matrix — the flagship ``VariantsPcaDriver`` math.
+
+Reference (SURVEY.md §3.1): N x N shared-alt similarity -> center by
+row/col/grand means -> MLlib ``RowMatrix.computePrincipalComponents(k)``
+-> project rows -> per-sample PC coordinates.
+
+For a *symmetric* centered matrix C, MLlib's route (eigenvectors v of the
+column covariance C^T C / n, then projection C v) is algebraically the
+spectral route used here: eigenvectors of C^T C = C^2 are eigenvectors of
+C ordered by |lambda|, and the projection is C v = lambda v. So the TPU
+path runs one symmetric eigh of C (ordered by |lambda|) and scales
+eigenvectors by their eigenvalues — identical output (up to per-component
+sign, the usual PCA ambiguity) at half the work; the CPU oracle implements
+MLlib's covariance route literally and the parity test pins the
+equivalence.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from spark_examples_tpu.ops.centering import center_matrix
+
+
+@dataclass
+class PCAResult:
+    coords: jnp.ndarray  # (N, k) projections onto top components
+    eigenvalues: jnp.ndarray  # (k,) matrix eigenvalues, by descending |.|
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _fit(similarity, k):
+    c = center_matrix(similarity)
+    c = 0.5 * (c + c.T)  # guard symmetry against accumulation round-off
+    vals, vecs = jnp.linalg.eigh(c)
+    order = jnp.argsort(-jnp.abs(vals))[:k]
+    vals_k = vals[order]
+    vecs_k = vecs[:, order]
+    coords = vecs_k * vals_k[None, :]  # projection C v = lambda v
+    return coords, vals_k
+
+
+def fit_pca(similarity: jnp.ndarray, k: int = 10) -> PCAResult:
+    coords, vals = _fit(similarity, k)
+    return PCAResult(coords, vals)

@@ -1,0 +1,53 @@
+"""PCoA (classical MDS) — the Stanford fork's PCoA entrypoint math.
+
+Reference (SURVEY.md §3.3): load distance matrix -> D^2 -> double-center
+(-1/2 J D^2 J) -> symmetric eig -> coords_i = eigvec_i * sqrt(lambda_i).
+Negative eigenvalues (non-Euclidean distances like Bray-Curtis produce
+them) are clamped to zero coordinates, matching scikit-bio's classical
+PCoA behaviour so the CPU oracle pins the same convention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from spark_examples_tpu.ops.centering import gower_center
+from spark_examples_tpu.ops.eigh import randomized_eigh, top_k_eigh
+
+
+@dataclass
+class PCoAResult:
+    coords: jnp.ndarray  # (N, k) principal coordinates
+    eigenvalues: jnp.ndarray  # (k,) descending
+    proportion_explained: jnp.ndarray  # (k,) fraction of positive inertia
+
+
+@partial(jax.jit, static_argnames=("k", "method"))
+def _fit(distance, k, method, key):
+    b = gower_center(distance)
+    trace = jnp.trace(b)  # total inertia = sum of all eigenvalues
+    if method == "dense":
+        vals, vecs = top_k_eigh(b, k)
+    else:
+        vals, vecs = randomized_eigh(b, k, key)
+    pos = jnp.maximum(vals, 0.0)
+    coords = vecs * jnp.sqrt(pos)[None, :]
+    prop = pos / jnp.maximum(trace, 1e-30)
+    return coords, vals, prop
+
+
+def fit_pcoa(
+    distance: jnp.ndarray,
+    k: int = 10,
+    method: str = "dense",
+    key: jax.Array | None = None,
+) -> PCoAResult:
+    """PCoA on an (N, N) distance matrix. ``method``: dense | randomized."""
+    if key is None:
+        key = jax.random.key(0)
+    coords, vals, prop = _fit(distance, k, method, key)
+    return PCoAResult(coords, vals, prop)

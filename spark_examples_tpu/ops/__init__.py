@@ -1,0 +1,1 @@
+from spark_examples_tpu.ops import centering, distances, eigh, genotype, gram  # noqa: F401

@@ -1,0 +1,34 @@
+"""Double-centering — step 3 of the reference pipeline.
+
+Reference: PCoA entrypoint computes B = -1/2 J D^2 J (J = I - 11^T/n) and
+the PCA driver centers its similarity matrix by row/col/grand means before
+eigendecomposition (SURVEY.md §3.1, §3.3). J is never materialised here:
+centering is rank-1 row/col mean subtraction, which XLA fuses into a
+couple of reductions + one elementwise kernel — O(N^2) reads, no matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def center_matrix(a: jnp.ndarray) -> jnp.ndarray:
+    """J A J: subtract row means, col means, add grand mean."""
+    row = a.mean(axis=1, keepdims=True)
+    col = a.mean(axis=0, keepdims=True)
+    grand = a.mean()
+    return a - row - col + grand
+
+
+@jax.jit
+def gower_center(distance: jnp.ndarray) -> jnp.ndarray:
+    """B = -1/2 J D^2 J from a distance matrix D (classical MDS / PCoA)."""
+    return -0.5 * center_matrix(distance * distance)
+
+
+@jax.jit
+def gower_center_from_squared(d2: jnp.ndarray) -> jnp.ndarray:
+    """Same, when the squared distances are already at hand."""
+    return -0.5 * center_matrix(d2)

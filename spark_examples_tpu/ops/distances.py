@@ -1,0 +1,109 @@
+"""Distance / similarity finalization and non-Gram pairwise metrics.
+
+Finalization consumes the accumulated Gram pieces
+(:mod:`spark_examples_tpu.ops.gram`) and produces the matrices the
+reference's job surface exposed: the SimilarityMatrix entrypoint's
+pairwise IBS matrix and the distance matrix the PCoA entrypoint consumes
+(SURVEY.md §3.2–3.3). Bray-Curtis — the alternate metric named by
+benchmark config 3 (BASELINE.md) — is not a bilinear form, so it gets a
+blocked elementwise path (and later a Pallas kernel) instead of matmuls.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def finalize(acc: dict, metric: str) -> dict[str, jnp.ndarray]:
+    """Accumulators -> {"similarity", "distance"} (N, N) f32 matrices.
+
+    IBS semantics follow the PLINK convention the reference family used:
+    over pairwise-complete variants, ``distance = sum|a-b| / (2 * m)`` and
+    ``similarity = 1 - distance``; pairs with zero shared valid variants
+    get distance 0 (they cannot be distinguished from identical — the
+    oracle encodes the same choice so parity tests pin it down).
+    """
+    if metric == "ibs":
+        m = acc["m"]
+        dist = jnp.where(m > 0, acc["d1"] / (2.0 * m), 0.0)
+        return {"similarity": 1.0 - dist, "distance": dist}
+    if metric == "ibs2":
+        m = acc["m"]
+        sim = jnp.where(m > 0, acc["ibs2"] / m, 1.0)
+        return {"similarity": sim, "distance": 1.0 - sim}
+    if metric == "shared-alt":
+        # The reference PCA driver's similarity: raw shared-alt-carrier
+        # counts (centering happens downstream, SURVEY.md §3.1).
+        s = acc["s"]
+        return {"similarity": s, "distance": similarity_to_distance(s)}
+    if metric == "euclidean":
+        d = jnp.sqrt(jnp.maximum(acc["e2"], 0.0))
+        return {"similarity": -d, "distance": d}
+    if metric == "grm":
+        g = acc["zz"] / jnp.maximum(acc["nvar"], 1.0)
+        return {"similarity": g, "distance": similarity_to_distance(g)}
+    if metric == "dot":
+        return {"similarity": acc["dot"],
+                "distance": similarity_to_distance(acc["dot"])}
+    raise ValueError(f"unknown metric {metric!r}")
+
+
+def similarity_to_distance(s: jnp.ndarray) -> jnp.ndarray:
+    """Gower transform: d_ij = sqrt(s_ii + s_jj - 2 s_ij) (>= 0 for PSD s)."""
+    diag = jnp.diagonal(s)
+    d2 = diag[:, None] + diag[None, :] - 2.0 * s
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+@partial(jax.jit, static_argnames=("row_tile", "feat_tile"))
+def pairwise_manhattan(
+    x: jnp.ndarray, row_tile: int = 128, feat_tile: int = 128
+) -> jnp.ndarray:
+    """Blocked sum_f |x_i - x_j|: (N, F) -> (N, N).
+
+    Double-tiled so peak memory is ``row_tile * N * feat_tile`` elements
+    regardless of F — the feature axis streams exactly like the variant
+    axis does in the Gram path. Runs on the VPU (elementwise), not the
+    MXU; the Pallas kernel in ops.pallas targets the same contraction.
+    """
+    n, f = x.shape
+    n_pad = -(-n // row_tile) * row_tile
+    f_pad = -(-f // feat_tile) * feat_tile
+    xp = jnp.pad(x.astype(jnp.float32), ((0, n_pad - n), (0, f_pad - f)))
+    k = f_pad // feat_tile
+    # (k, N_pad, feat_tile) feature chunks of the full matrix
+    cols = xp.reshape(n_pad, k, feat_tile).transpose(1, 0, 2)
+
+    def row_block(rb):  # rb: (row_tile, f_pad)
+        a_chunks = rb.reshape(row_tile, k, feat_tile).transpose(1, 0, 2)
+
+        def feat_step(acc, ab):
+            a, b = ab  # (row_tile, ft), (n_pad, ft)
+            acc = acc + jnp.abs(a[:, None, :] - b[None, :, :]).sum(-1)
+            return acc, None
+
+        acc0 = jnp.zeros((row_tile, n_pad), jnp.float32)
+        acc, _ = lax.scan(feat_step, acc0, (a_chunks, cols))
+        return acc
+
+    blocks = lax.map(row_block, xp.reshape(n_pad // row_tile, row_tile, f_pad))
+    return blocks.reshape(n_pad, n_pad)[:n, :n]
+
+
+@partial(jax.jit, static_argnames=("row_tile", "feat_tile"))
+def braycurtis(
+    x: jnp.ndarray, row_tile: int = 128, feat_tile: int = 128
+) -> jnp.ndarray:
+    """Bray-Curtis dissimilarity on a nonnegative (N, F) abundance table.
+
+    BC_ij = sum_f |x_i - x_j| / sum_f (x_i + x_j), the metric of benchmark
+    config 3 (10k-sample OTU table, BASELINE.md). Zero-total pairs get 0.
+    """
+    num = pairwise_manhattan(x, row_tile=row_tile, feat_tile=feat_tile)
+    totals = x.astype(jnp.float32).sum(axis=1)
+    den = totals[:, None] + totals[None, :]
+    return jnp.where(den > 0, num / den, 0.0)

@@ -1,0 +1,75 @@
+"""Symmetric eigendecomposition — step 4 of the reference pipeline.
+
+The reference collected the N x N matrix to the Spark driver and ran
+LAPACK via MLlib ``RowMatrix.computePrincipalComponents`` — its scaling
+wall (SURVEY.md §3.1 HOT LOOP #3). Here the matrix is already on device:
+
+- :func:`top_k_eigh` — full dense ``jax.numpy.linalg.eigh`` (XLA's
+  on-device QDWH/tridiagonal path), then slice the top k. Right answer up
+  to N in the tens of thousands on one chip.
+- :func:`randomized_eigh` — randomized subspace iteration (Halko-style;
+  see PAPERS.md: arxiv 1612.08709, 2110.03423) for the large-N / sharded
+  regime: k + p probes, a few power iterations, small host-side eigh of
+  the Rayleigh quotient. Only needs B through matvec-blocks (matmul
+  shaped, MXU friendly) — this is the path the 76k-exome benchmark config
+  uses, and the building block for the streaming rank-k updates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k",))
+def top_k_eigh(b: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k eigenpairs of symmetric ``b``, eigenvalues descending.
+
+    Returns (vals (k,), vecs (N, k)).
+    """
+    vals, vecs = jnp.linalg.eigh(b)  # ascending
+    vals = vals[::-1][:k]
+    vecs = vecs[:, ::-1][:, :k]
+    return vals, vecs
+
+
+@partial(jax.jit, static_argnames=("k", "oversample", "iters"))
+def randomized_eigh(
+    b: jnp.ndarray,
+    k: int,
+    key: jax.Array,
+    oversample: int = 16,
+    iters: int = 4,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Randomized top-k eigenpairs of symmetric ``b``.
+
+    Subspace iteration with QR re-orthonormalisation each step; accuracy
+    for PCoA-class spectra (fast decay) is ample with the defaults. The
+    only large-N operations are ``b @ q`` products — (N, N) x (N, k+p)
+    matmuls that tile onto the MXU and shard cleanly over the mesh.
+    """
+    n = b.shape[0]
+    p = k + oversample
+    q = jax.random.normal(key, (n, p), dtype=b.dtype)
+    q, _ = jnp.linalg.qr(b @ q)
+
+    def step(q, _):
+        q, _ = jnp.linalg.qr(b @ q)
+        return q, None
+
+    q, _ = jax.lax.scan(step, q, None, length=iters)
+    # Rayleigh quotient: small (p, p) symmetric problem.
+    t = q.T @ (b @ q)
+    t = 0.5 * (t + t.T)
+    vals, s = jnp.linalg.eigh(t)
+    vals = vals[::-1][:k]
+    vecs = (q @ s)[:, ::-1][:, :k]
+    return vals, vecs
+
+
+def eigh_flops(n: int) -> float:
+    """Rough dense-eigh FLOP count (~9 n^3 for tridiag + QR) for the
+    eigh-GFLOPS/chip north-star metric (BASELINE.md)."""
+    return 9.0 * float(n) ** 3

@@ -1,0 +1,116 @@
+"""Genotype-block transforms: the matmul reformulation of pair counting.
+
+This module is the heart of the parity story. The reference built its
+pairwise similarity by *pair emission + reduceByKey*: for each variant,
+emit a count for every pair of samples sharing a genotype state, shuffle,
+and sum (SURVEY.md §3.1 HOT LOOP #2 — O(variants x carriers^2) pair
+emission). That shape is hostile to an MXU. The TPU-native reformulation
+turns the same counts into three matmuls.
+
+For a dosage block ``G`` of shape (N, V) with values {0, 1, 2, -1=missing},
+define int indicator matrices (computed in :func:`thresholds`):
+
+    C  = [G >= 0]   valid (non-missing) call
+    T1 = [G >= 1]   carries at least one alt allele
+    T2 = [G >= 2]   homozygous alt
+
+Every pairwise co-occurrence count the reference's reduceByKey produced is
+a bilinear form in {C, T1, T2} (one-hot states are X0 = C - T1,
+X1 = T1 - T2, X2 = T2):
+
+    valid pair count        M    = C  C^T
+    shared-alt count        S    = T1 T1^T            (the reference PCA
+                                   driver's similarity: #variants where
+                                   both samples carry >=1 alt)
+    sum of dosages a+b      A+A^T with A = (T1+T2) C^T
+    sum of min(a, b)        P    = T1 T1^T + T2 T2^T
+    Manhattan sum |a-b|     D1   = A + A^T - 2 P      (|a-b| = a+b-2min)
+    IBS2 count (a == b)     sum_g X_g X_g^T  — expands into the six
+                            products of {C, T1, T2}
+
+so a *single* stacked matmul ``Z Z^T`` with ``Z = concat([C, T1, T2])``
+(or the six unique pairwise products in blocked form) yields every
+statistic. All downstream metrics (ops.distances) consume these Gram
+pieces; the full-matrix algebra never touches per-variant state again —
+exactly the associative-accumulation property the reference exploited via
+reduceByKey, now exploited via blocked FMA into an N x N accumulator
+(SURVEY.md §5 "Long-context": the 40M-variant axis is streamed).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_examples_tpu.core.dtypes import COMPUTE_DTYPE
+
+
+def thresholds(block: jnp.ndarray, dtype=COMPUTE_DTYPE):
+    """(N, V) int8 dosages -> stacked (3, N, V) indicators [C, T1, T2].
+
+    Missing (-1) contributes zero to every indicator, which is what gives
+    the pairwise-complete semantics: a pair's statistics at a variant
+    count only when *both* calls are valid (product of indicators).
+    """
+    c = (block >= 0).astype(dtype)
+    t1 = (block >= 1).astype(dtype)
+    t2 = (block >= 2).astype(dtype)
+    return jnp.stack([c, t1, t2])
+
+
+def _xxt(a: jnp.ndarray, b: jnp.ndarray, accum_dtype) -> jnp.ndarray:
+    """``a @ b^T`` with f32 MXU accumulation — one (N, V) x (V, N) dot."""
+    return jax.lax.dot_general(
+        a,
+        b,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=accum_dtype,
+    )
+
+
+def gram_pieces(block: jnp.ndarray, accum_dtype=jnp.float32) -> dict[str, jnp.ndarray]:
+    """Per-block contributions to the named pairwise statistics.
+
+    Returns a dict of (N, N) f32 arrays:
+      ``m``   — valid-pair counts            C C^T
+      ``s``   — shared-alt counts            T1 T1^T
+      ``d1``  — Manhattan (sum |a-b|)        A + A^T - 2 P
+      ``ibs2``— exact-match counts           sum_g X_g X_g^T
+      ``dot`` — dosage inner products        y y^T (y = masked dosage)
+      ``e2``  — squared euclidean over valid pairs
+
+    Each product is a separate ``dot_general`` so that, under ``jit``,
+    products feeding only unselected pieces are dead-code-eliminated —
+    the IBS metric, for instance, compiles to exactly the 4 matmuls it
+    needs (C C^T, T1 C^T, T2 C^T fused-stack, T1 T1^T, T2 T2^T), not all
+    six unique indicator products.
+
+    Each piece is additive across variant blocks, so the streaming driver
+    just FMAs them into resident accumulators.
+    """
+    c, t1, t2 = thresholds(block)
+    cc = _xxt(c, c, accum_dtype)
+    t1c = _xxt(t1, c, accum_dtype)
+    t2c = _xxt(t2, c, accum_dtype)
+    t1t1 = _xxt(t1, t1, accum_dtype)
+    t1t2 = _xxt(t1, t2, accum_dtype)
+    t2t2 = _xxt(t2, t2, accum_dtype)
+    ct1, ct2, t2t1 = t1c.T, t2c.T, t1t2.T
+
+    a = t1c + t2c  # A = (T1 + T2) C^T ; sum of dosage a over valid pairs
+    p = t1t1 + t2t2  # sum of min(a, b)
+    d1 = a + a.T - 2.0 * p
+    # IBS2 = sum over one-hot states; expand (C-T1)(C-T1)^T + (T1-T2)(T1-T2)^T
+    # + T2 T2^T in terms of the nine products.
+    ibs2 = (
+        cc - ct1 - t1c + t1t1  # X0 X0^T
+        + t1t1 - t1t2 - t2t1 + t2t2  # X1 X1^T
+        + t2t2  # X2 X2^T
+    )
+    # dosage dot product y y^T with y = T1 + T2:
+    dot = t1t1 + t1t2 + t2t1 + t2t2
+    # squared-euclidean over valid pairs: sum c_i c_j (a - b)^2
+    #   = Q C^T + C Q^T - 2 y y^T  with Q = d^2 masked = T1 + 3 T2
+    q = (t1c + 3.0 * t2c)
+    e2 = q + q.T - 2.0 * dot
+    return {"m": cc, "s": t1t1, "d1": d1, "ibs2": ibs2, "dot": dot, "e2": e2}

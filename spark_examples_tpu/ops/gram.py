@@ -1,0 +1,107 @@
+"""Blocked, streaming Gram/similarity accumulation — the reference's
+shuffle stage, rebuilt as FMA into resident accumulators.
+
+Reference semantics (SURVEY.md §3.1): per-variant pair emission →
+``reduceByKey`` over the netty shuffle → N x N similarity assembled on the
+driver. The associativity that made reduceByKey work is the same property
+exploited here: every pairwise statistic is a sum over variants, so the
+driver streams (N, v_blk) dosage blocks through the chip and adds each
+block's :func:`~spark_examples_tpu.ops.genotype.gram_pieces` contribution
+into f32 accumulators resident in HBM. The 40M-variant axis never
+materialises on device — only one block plus the N x N state
+(SURVEY.md §5 "Long-context").
+
+Two block transforms live here:
+
+- :func:`update` — indicator-product pieces (IBS / shared-alt / euclidean
+  / IBS2 families, all pairwise-complete over missing data);
+- :func:`update_grm` — the standardized-dosage GRM (VanRaden/GCTA form):
+  per-variant allele frequency estimated *within the block*, dosages
+  centered by 2p and scaled by 1/sqrt(2p(1-p)), missing mean-imputed to
+  zero contribution, accumulated as Z Z^T.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from spark_examples_tpu.core.dtypes import COMPUTE_DTYPE
+from spark_examples_tpu.ops.genotype import gram_pieces
+
+# Which gram pieces each metric needs. Under jit, unused pieces (and the
+# indicator matmuls feeding only them) are dead-code-eliminated.
+# ("braycurtis" is NOT a gram metric — it is not a bilinear form; the
+# pipeline dispatches it to distances.braycurtis over dense tables.)
+PIECES_FOR_METRIC: dict[str, tuple[str, ...]] = {
+    "ibs": ("d1", "m"),
+    "ibs2": ("ibs2", "m"),
+    "shared-alt": ("s",),
+    "euclidean": ("e2",),
+    "dot": ("dot",),
+}
+
+GRAM_METRICS = tuple(PIECES_FOR_METRIC) + ("grm",)
+
+# Unique indicator matmuls each metric's selected pieces actually execute
+# after dead-code elimination (see gram_pieces): used for honest GFLOPS.
+_N_PRODUCTS = {"ibs": 5, "ibs2": 5, "shared-alt": 1, "euclidean": 5,
+               "dot": 3, "grm": 1}
+
+
+def flops_per_block(n: int, v: int, metric: str) -> float:
+    """Matmul FLOPs one block contributes (for GFLOPS reporting)."""
+    return 2.0 * n * n * v * _N_PRODUCTS.get(metric, 6)
+
+
+def _check_metric(metric: str) -> None:
+    if metric not in GRAM_METRICS:
+        raise ValueError(
+            f"unknown gram metric {metric!r}; valid: {sorted(GRAM_METRICS)} "
+            "(braycurtis runs via distances.braycurtis, not the gram path)"
+        )
+
+
+def init(n: int, metric: str) -> dict[str, jnp.ndarray]:
+    """Fresh zero accumulators for ``metric`` on the default device."""
+    _check_metric(metric)
+    if metric == "grm":
+        return {
+            "zz": jnp.zeros((n, n), jnp.float32),
+            "nvar": jnp.zeros((), jnp.float32),
+        }
+    pieces = PIECES_FOR_METRIC[metric]
+    return {k: jnp.zeros((n, n), jnp.float32) for k in pieces}
+
+
+@partial(jax.jit, static_argnames=("pieces",), donate_argnums=(0,))
+def _update(acc, block, pieces: tuple[str, ...]):
+    g = gram_pieces(block)
+    return {k: acc[k] + g[k] for k in pieces}
+
+
+def update(acc: dict, block: jnp.ndarray, metric: str) -> dict:
+    """Add one (N, v_blk) int8 dosage block's contribution to ``acc``."""
+    _check_metric(metric)
+    if metric == "grm":
+        return update_grm(acc, block)
+    return _update(acc, block, PIECES_FOR_METRIC[metric])
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_grm(acc: dict, block: jnp.ndarray) -> dict:
+    """VanRaden-form GRM accumulation with in-block allele frequencies."""
+    valid = (block >= 0)
+    y = jnp.where(valid, block, 0).astype(jnp.float32)
+    cnt = valid.sum(axis=0).astype(jnp.float32)  # calls per variant
+    p = jnp.where(cnt > 0, y.sum(axis=0) / (2.0 * cnt), 0.0)
+    denom = 2.0 * p * (1.0 - p)
+    keep = (denom > 1e-8) & (cnt > 1)
+    scale = jnp.where(keep, jax.lax.rsqrt(jnp.maximum(denom, 1e-8)), 0.0)
+    z = jnp.where(valid, (y - 2.0 * p) * scale, 0.0).astype(COMPUTE_DTYPE)
+    zz = jax.lax.dot_general(
+        z, z, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return {"zz": acc["zz"] + zz, "nvar": acc["nvar"] + keep.sum()}

@@ -1,0 +1,1 @@
+from spark_examples_tpu.utils import oracle  # noqa: F401

@@ -1,0 +1,141 @@
+"""CPU oracle: obviously-correct NumPy implementations for parity tests,
+plus an optimized NumPy backend standing in for the reference baseline.
+
+Two tiers (SURVEY.md §5 "Config / flag system", §7 hard-part #1):
+
+- ``naive_*`` — direct per-pair loops over variants with explicit missing
+  handling. Slow, tiny-input only; they *define* the semantics. The
+  matmul reformulation in ops.genotype must match these exactly — this is
+  the parity risk the survey flags (the reference's reduceByKey counting
+  semantics), so the definitions here are the contract.
+- ``cpu_*`` — vectorized NumPy (same math as the TPU path). This is the
+  ``--backend=cpu-reference`` implementation and the measured stand-in
+  for the Spark MLlib baseline in the Spark-less environment.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+# ----------------------------------------------------------------- naive
+
+def naive_pairwise(genotypes: np.ndarray):
+    """Per-pair statistics by explicit iteration. genotypes: (N, V) int8.
+
+    Returns dict of (N, N) f64: m (valid pairs), d1 (sum |a-b|), s
+    (shared-alt count), ibs2 (exact matches), dot, e2.
+    """
+    g = genotypes.astype(np.int64)
+    n = g.shape[0]
+    out = {k: np.zeros((n, n)) for k in ("m", "d1", "s", "ibs2", "dot", "e2")}
+    for i in range(n):
+        for j in range(n):
+            a, b = g[i], g[j]
+            valid = (a >= 0) & (b >= 0)
+            av, bv = a[valid], b[valid]
+            out["m"][i, j] = valid.sum()
+            out["d1"][i, j] = np.abs(av - bv).sum()
+            out["s"][i, j] = ((av >= 1) & (bv >= 1)).sum()
+            out["ibs2"][i, j] = (av == bv).sum()
+            out["dot"][i, j] = (av * bv).sum()
+            out["e2"][i, j] = ((av - bv) ** 2).sum()
+    return out
+
+
+def naive_ibs_distance(genotypes: np.ndarray) -> np.ndarray:
+    p = naive_pairwise(genotypes)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        d = np.where(p["m"] > 0, p["d1"] / (2.0 * p["m"]), 0.0)
+    return d
+
+
+def naive_braycurtis(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    d = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            num = np.abs(x[i] - x[j]).sum()
+            den = (x[i] + x[j]).sum()
+            d[i, j] = num / den if den > 0 else 0.0
+    return d
+
+
+def naive_grm(genotypes: np.ndarray) -> np.ndarray:
+    """VanRaden GRM with in-matrix allele frequencies, mean-imputed
+    missing — matches ops.gram.update_grm run as one block."""
+    g = genotypes.astype(np.float64)
+    valid = g >= 0
+    y = np.where(valid, g, 0.0)
+    cnt = valid.sum(axis=0)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        p = np.where(cnt > 0, y.sum(axis=0) / (2.0 * cnt), 0.0)
+    denom = 2.0 * p * (1.0 - p)
+    keep = (denom > 1e-8) & (cnt > 1)
+    scale = np.where(keep, 1.0 / np.sqrt(np.maximum(denom, 1e-8)), 0.0)
+    z = np.where(valid, (y - 2.0 * p) * scale, 0.0)
+    return (z @ z.T) / max(keep.sum(), 1)
+
+
+# ------------------------------------------------------- centering / eig
+
+def center_matrix(a: np.ndarray) -> np.ndarray:
+    return a - a.mean(1, keepdims=True) - a.mean(0, keepdims=True) + a.mean()
+
+
+def pcoa(distance: np.ndarray, k: int = 10):
+    """Classical MDS: returns (coords, eigenvalues, proportion)."""
+    b = -0.5 * center_matrix(distance.astype(np.float64) ** 2)
+    vals, vecs = np.linalg.eigh(b)
+    vals, vecs = vals[::-1][:k], vecs[:, ::-1][:, :k]
+    pos = np.maximum(vals, 0.0)
+    coords = vecs * np.sqrt(pos)[None, :]
+    prop = pos / max(np.trace(b), 1e-30)
+    return coords, vals, prop
+
+
+def pca_mllib_route(similarity: np.ndarray, k: int = 10):
+    """The reference's literal route (SURVEY.md §3.1): center, column
+    covariance, eigenvectors, project rows. Used to pin the equivalence
+    claimed in models/pca.py."""
+    c = center_matrix(similarity.astype(np.float64))
+    cov = (c.T @ c) / c.shape[0]
+    vals, vecs = np.linalg.eigh(cov)
+    vecs = vecs[:, ::-1][:, :k]
+    return c @ vecs  # (N, k) projections
+
+
+# --------------------------------------------------------- cpu backend
+
+def cpu_gram_pieces(genotypes: np.ndarray):
+    """Vectorized NumPy mirror of ops.genotype.gram_pieces (f64)."""
+    g = genotypes
+    c = (g >= 0).astype(np.float64)
+    t1 = (g >= 1).astype(np.float64)
+    t2 = (g >= 2).astype(np.float64)
+    cc = c @ c.T
+    t1c = t1 @ c.T
+    t2c = t2 @ c.T
+    t1t1 = t1 @ t1.T
+    t1t2 = t1 @ t2.T
+    t2t2 = t2 @ t2.T
+    a = t1c + t2c
+    p = t1t1 + t2t2
+    d1 = a + a.T - 2.0 * p
+    ibs2 = cc - t1c - t1c.T + 2.0 * t1t1 - t1t2 - t1t2.T + 2.0 * t2t2
+    dot = t1t1 + t1t2 + t1t2.T + t2t2
+    q = t1c + 3.0 * t2c
+    e2 = q + q.T - 2.0 * dot
+    return {"m": cc, "s": t1t1, "d1": d1, "ibs2": ibs2, "dot": dot, "e2": e2}
+
+
+def cpu_ibs_distance(genotypes: np.ndarray) -> np.ndarray:
+    p = cpu_gram_pieces(genotypes)
+    return np.where(p["m"] > 0, p["d1"] / (2.0 * p["m"]), 0.0)
+
+
+def cpu_braycurtis(x: np.ndarray) -> np.ndarray:
+    from scipy.spatial.distance import pdist, squareform
+
+    d = squareform(pdist(x.astype(np.float64), metric="braycurtis"))
+    return np.nan_to_num(d, nan=0.0)

@@ -1,0 +1,37 @@
+"""Test bootstrap: force the JAX CPU backend with 8 virtual devices.
+
+Mirrors the reference's `local[*]` testing story (SURVEY.md §4): the same
+sharded code paths (mesh, shard_map, collectives) run multi-"device" in
+one process, so distributed logic is exercised without TPU hardware.
+Must run before the first `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def random_genotypes(rng, n, v, missing_rate=0.1):
+    """Random dosage matrix with missing calls, int8."""
+    g = rng.integers(0, 3, size=(n, v), dtype=np.int8)
+    miss = rng.random((n, v)) < missing_rate
+    g[miss] = -1
+    return g
+
+
+@pytest.fixture
+def genotypes(rng):
+    return random_genotypes(rng, n=37, v=211, missing_rate=0.15)

@@ -1,0 +1,66 @@
+import numpy as np
+import pytest
+
+from spark_examples_tpu.ops import distances, gram
+from spark_examples_tpu.utils import oracle
+from tests.conftest import random_genotypes
+
+
+def _finalized(genotypes, metric):
+    acc = gram.init(genotypes.shape[0], metric)
+    acc = gram.update(acc, genotypes, metric)
+    return distances.finalize(acc, metric)
+
+
+def test_ibs_distance_matches_naive(genotypes):
+    got = np.asarray(_finalized(genotypes, "ibs")["distance"])
+    want = oracle.naive_ibs_distance(genotypes)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    # symmetric, zero diagonal
+    np.testing.assert_allclose(got, got.T, atol=1e-7)
+    np.testing.assert_allclose(np.diag(got), 0.0, atol=1e-7)
+
+
+def test_ibs_zero_overlap_pair(rng):
+    g = random_genotypes(rng, n=6, v=30, missing_rate=0.0)
+    g[0, :15] = -1
+    g[1, 15:] = -1  # samples 0 and 1 share no valid variant
+    out = np.asarray(_finalized(g, "ibs")["distance"])
+    assert out[0, 1] == 0.0  # pinned convention (see distances.finalize)
+
+
+def test_euclidean_matches_naive(genotypes):
+    got = np.asarray(_finalized(genotypes, "euclidean")["distance"])
+    want = np.sqrt(oracle.naive_pairwise(genotypes)["e2"])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape", [(17, 33), (64, 128), (130, 257)])
+def test_braycurtis_matches_naive(rng, shape):
+    x = rng.gamma(2.0, 10.0, size=shape) * (rng.random(shape) > 0.3)
+    got = np.asarray(distances.braycurtis(x, row_tile=32, feat_tile=32))
+    want = oracle.naive_braycurtis(x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_braycurtis_matches_scipy(rng):
+    x = rng.gamma(2.0, 10.0, size=(25, 71))
+    got = np.asarray(distances.braycurtis(x, row_tile=16, feat_tile=16))
+    want = oracle.cpu_braycurtis(x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_manhattan_padding_is_neutral(rng):
+    x = rng.random((19, 23))
+    got = np.asarray(distances.pairwise_manhattan(x, row_tile=8, feat_tile=8))
+    want = np.abs(x[:, None, :] - x[None, :, :]).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_similarity_to_distance_gower(rng):
+    # For a Gram matrix G = X X^T the Gower distance is euclidean distance.
+    x = rng.random((12, 5))
+    g = x @ x.T
+    got = np.asarray(distances.similarity_to_distance(g))
+    want = np.sqrt(((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,61 @@
+"""Parity of the matmul reformulation against the naive oracle.
+
+This is SURVEY.md §7 hard-part #1: the pair-count -> matmul rewrite must
+reproduce the reference's reduceByKey counting semantics (including
+missing-genotype handling) exactly. The naive oracle defines those
+semantics; every gram piece must match it to the integer.
+"""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.ops import genotype, gram
+from spark_examples_tpu.utils import oracle
+from tests.conftest import random_genotypes
+
+PIECES = ("m", "s", "d1", "ibs2", "dot", "e2")
+
+
+@pytest.mark.parametrize("missing_rate", [0.0, 0.15, 0.6])
+def test_gram_pieces_match_naive(rng, missing_rate):
+    g = random_genotypes(rng, n=23, v=157, missing_rate=missing_rate)
+    got = {k: np.asarray(v) for k, v in genotype.gram_pieces(g).items()}
+    want = oracle.naive_pairwise(g)
+    for k in PIECES:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=f"piece {k}")
+
+
+def test_gram_pieces_all_missing_column(rng):
+    g = random_genotypes(rng, n=11, v=40, missing_rate=0.1)
+    g[:, 7] = -1  # fully missing variant must contribute nothing
+    got = {k: np.asarray(v) for k, v in genotype.gram_pieces(g).items()}
+    want = oracle.naive_pairwise(g)
+    for k in PIECES:
+        np.testing.assert_array_equal(got[k], want[k])
+
+
+def test_blocked_accumulation_equals_single_shot(genotypes):
+    """Streaming over variant blocks == one-shot (associativity)."""
+    n, v = genotypes.shape
+    acc = gram.init(n, "ibs")
+    for start in range(0, v, 64):
+        acc = gram.update(acc, genotypes[:, start : start + 64], "ibs")
+    whole = genotype.gram_pieces(genotypes)
+    np.testing.assert_array_equal(np.asarray(acc["d1"]), np.asarray(whole["d1"]))
+    np.testing.assert_array_equal(np.asarray(acc["m"]), np.asarray(whole["m"]))
+
+
+def test_cpu_backend_matches_naive(genotypes):
+    got = oracle.cpu_gram_pieces(genotypes)
+    want = oracle.naive_pairwise(genotypes)
+    for k in PIECES:
+        np.testing.assert_allclose(got[k], want[k], err_msg=f"piece {k}")
+
+
+def test_grm_matches_naive(genotypes):
+    acc = gram.init(genotypes.shape[0], "grm")
+    acc = gram.update(acc, genotypes, "grm")
+    got = np.asarray(acc["zz"] / np.maximum(np.asarray(acc["nvar"]), 1.0))
+    want = oracle.naive_grm(genotypes)
+    # bf16 standardized dosages: tolerance, not exactness.
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)

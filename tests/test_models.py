@@ -1,0 +1,74 @@
+import numpy as np
+
+import jax
+
+from spark_examples_tpu.models.pca import fit_pca
+from spark_examples_tpu.models.pcoa import fit_pcoa
+from spark_examples_tpu.ops import centering, eigh
+from spark_examples_tpu.utils import oracle
+
+
+def _psd(rng, n):
+    x = rng.standard_normal((n, n))
+    return (x @ x.T).astype(np.float32)
+
+
+def test_center_matrix_matches_oracle(rng):
+    a = rng.random((31, 31)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(centering.center_matrix(a)),
+        oracle.center_matrix(a),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_top_k_eigh_matches_numpy(rng):
+    b = _psd(rng, 40)
+    vals, vecs = eigh.top_k_eigh(b, 5)
+    wv = np.linalg.eigvalsh(b.astype(np.float64))[::-1][:5]
+    np.testing.assert_allclose(np.asarray(vals), wv, rtol=1e-4)
+    # residual check: B v = lambda v
+    res = b @ np.asarray(vecs) - np.asarray(vecs) * np.asarray(vals)
+    assert np.abs(res).max() < 1e-2 * np.abs(wv[0])
+
+
+def test_randomized_eigh_close_to_dense(rng):
+    b = _psd(rng, 120)
+    k = 6
+    dv, _ = eigh.top_k_eigh(b, k)
+    rv, rvecs = eigh.randomized_eigh(b, k, jax.random.key(0), oversample=20, iters=6)
+    np.testing.assert_allclose(np.asarray(rv), np.asarray(dv), rtol=1e-3)
+    res = b @ np.asarray(rvecs) - np.asarray(rvecs) * np.asarray(rv)
+    assert np.abs(res).max() < 1e-2 * float(dv[0])
+
+
+def test_pcoa_matches_oracle(rng):
+    # Euclidean distances of random points: PCoA must recover them.
+    x = rng.standard_normal((30, 4))
+    d = np.sqrt(((x[:, None] - x[None, :]) ** 2).sum(-1)).astype(np.float32)
+    res = fit_pcoa(d, k=4)
+    coords, vals, prop = oracle.pcoa(d, k=4)
+    np.testing.assert_allclose(np.asarray(res.eigenvalues), vals, rtol=1e-3, atol=1e-3)
+    # coords match up to per-axis sign
+    got, want = np.asarray(res.coords), coords
+    for c in range(4):
+        assert (
+            np.allclose(got[:, c], want[:, c], atol=1e-2)
+            or np.allclose(got[:, c], -want[:, c], atol=1e-2)
+        )
+    # pairwise distances reconstructed from 4 coords == original (exact rank)
+    rec = np.sqrt(((got[:, None] - got[None, :]) ** 2).sum(-1))
+    np.testing.assert_allclose(rec, d, atol=1e-2)
+
+
+def test_pca_equivalent_to_mllib_route(rng):
+    s = _psd(rng, 35)
+    res = fit_pca(s, k=4)
+    want = oracle.pca_mllib_route(s, k=4)
+    got = np.asarray(res.coords)
+    for c in range(4):
+        assert (
+            np.allclose(got[:, c], want[:, c], atol=1e-2)
+            or np.allclose(got[:, c], -want[:, c], atol=1e-2)
+        ), f"component {c} mismatch"
