@@ -1,0 +1,1 @@
+from spark_examples_tpu.cli.main import main  # noqa: F401

@@ -1,0 +1,180 @@
+"""CLI — the ``spark-submit --class ...Driver`` surface, as subcommands.
+
+Flag semantics mirror the reference's Scallop confs (SURVEY.md §5
+"Config / flag system"): ``--references chr:start:end``, ``--output-path``,
+block/partition sizing, plus the mandated backend gate
+``--backend={cpu-reference|jax-tpu}`` (BASELINE.json:5 prescribes
+``{spark-mllib|jax-tpu}``; the CPU oracle stands in for MLlib here).
+
+    python -m spark_examples_tpu similarity --metric ibs --output-path m.tsv
+    python -m spark_examples_tpu pcoa --num-pc 10 --output-path coords.tsv
+    python -m spark_examples_tpu pca  --output-path coords.tsv
+    python -m spark_examples_tpu search-variants --positions 16050075
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from spark_examples_tpu.core.config import (
+    ComputeConfig,
+    IngestConfig,
+    JobConfig,
+    ReferenceRange,
+)
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("ingest")
+    g.add_argument("--source", default="synthetic",
+                   choices=["synthetic", "vcf", "packed"])
+    g.add_argument("--path", default=None,
+                   help="input file/dir for vcf or packed sources")
+    g.add_argument("--references", nargs="*", default=[],
+                   metavar="CONTIG:START:END",
+                   help="genomic ranges to ingest (VCF region filter)")
+    g.add_argument("--n-samples", type=int, default=2504)
+    g.add_argument("--n-variants", type=int, default=100_000)
+    g.add_argument("--n-populations", type=int, default=5)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--block-variants", type=int, default=8192,
+                   help="variants per streamed block (the partition size)")
+    c = p.add_argument_group("compute")
+    c.add_argument("--backend", default="jax-tpu",
+                   choices=["jax-tpu", "cpu-reference"])
+    c.add_argument("--metric", default="ibs",
+                   choices=["ibs", "ibs2", "shared-alt", "grm", "euclidean",
+                            "dot", "braycurtis"])
+    c.add_argument("--num-pc", type=int, default=10)
+    c.add_argument("--mesh-shape", default=None,
+                   help="IxJ, e.g. 2x4 (default: auto-factor devices)")
+    c.add_argument("--gram-mode", default="auto",
+                   choices=["auto", "replicated", "variant", "tile2d"])
+    c.add_argument("--eigh-mode", default="auto",
+                   choices=["auto", "dense", "randomized"])
+    c.add_argument("--checkpoint-dir", default=None)
+    c.add_argument("--checkpoint-every-blocks", type=int, default=0)
+    p.add_argument("--output-path", default=None)
+    p.add_argument("--timings", action="store_true",
+                   help="print per-phase timing JSON to stderr")
+
+
+def _job_from_args(args) -> JobConfig:
+    mesh_shape = None
+    if args.mesh_shape:
+        i, j = args.mesh_shape.lower().split("x")
+        mesh_shape = (int(i), int(j))
+    return JobConfig(
+        ingest=IngestConfig(
+            source=args.source,
+            path=args.path,
+            references=[ReferenceRange.parse(r) for r in args.references],
+            n_samples=args.n_samples,
+            n_variants=args.n_variants,
+            n_populations=args.n_populations,
+            block_variants=args.block_variants,
+            seed=args.seed,
+        ),
+        compute=ComputeConfig(
+            backend=args.backend,
+            metric=args.metric,
+            num_pc=args.num_pc,
+            mesh_shape=mesh_shape,
+            gram_mode=args.gram_mode,
+            eigh_mode=args.eigh_mode,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every_blocks=args.checkpoint_every_blocks,
+        ),
+        output_path=args.output_path,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="spark_examples_tpu",
+        description="TPU-native population-genomics pipelines "
+        "(similarity / PCoA / PCA / search)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_sim = sub.add_parser("similarity", help="pairwise similarity matrix")
+    _add_common(p_sim)
+
+    p_pcoa = sub.add_parser("pcoa", help="principal coordinates analysis")
+    _add_common(p_pcoa)
+    p_pcoa.add_argument("--matrix-path", default=None,
+                        help="consume a persisted similarity/distance matrix")
+    p_pcoa.add_argument("--matrix-kind", default="auto",
+                        choices=["auto", "distance", "similarity"],
+                        help="what the persisted matrix holds (auto: trust "
+                        "the file's sidecar, else assume distance)")
+
+    p_pca = sub.add_parser("pca", help="flagship variants-PCA driver")
+    _add_common(p_pca)
+
+    p_sv = sub.add_parser("search-variants",
+                          help="genotype histograms at positions")
+    _add_common(p_sv)
+    p_sv.add_argument("--positions", nargs="*", type=int, default=None)
+
+    args = parser.parse_args(argv)
+    job = _job_from_args(args)
+
+    # Imports deferred so --help stays instant (no jax/TPU init).
+    from spark_examples_tpu.pipelines import jobs as J
+    from spark_examples_tpu.pipelines.runner import build_source
+
+    if args.command == "similarity":
+        res = J.similarity_matrix_job(job)
+        print(
+            f"similarity[{res.metric}] {res.similarity.shape[0]}x"
+            f"{res.similarity.shape[1]} over {res.n_variants} variants"
+            + (f" -> {job.output_path}" if job.output_path else "")
+        )
+        timer = res.timer
+    elif args.command == "pcoa":
+        out = J.pcoa_job(job, matrix_path=args.matrix_path,
+                         matrix_kind=getattr(args, "matrix_kind", "auto"))
+        _print_coords(out, job)
+        timer = out.timer
+    elif args.command == "pca":
+        out = J.variants_pca_job(job)
+        _print_coords(out, job)
+        timer = out.timer
+    elif args.command == "search-variants":
+        from spark_examples_tpu.pipelines.examples import genotype_histogram
+
+        src = build_source(job.ingest)
+        positions = set(args.positions) if args.positions else None
+        counts = genotype_histogram(src, job.ingest.block_variants, positions)
+        for c in counts[:50]:
+            print(
+                f"{c.contig or '?'}:{c.position}\t0/0={c.hom_ref}\t"
+                f"0/1={c.het}\t1/1={c.hom_alt}\t./.={c.missing}\t"
+                f"af={c.allele_freq:.4f}"
+            )
+        if len(counts) > 50:
+            print(f"... {len(counts) - 50} more variants")
+        return 0
+    else:  # pragma: no cover
+        parser.error(f"unknown command {args.command}")
+
+    if args.timings:
+        print(json.dumps(timer.report(), sort_keys=True), file=sys.stderr)
+    return 0
+
+
+def _print_coords(out, job: JobConfig) -> None:
+    k = out.coords.shape[1]
+    print(
+        f"{len(out.sample_ids)} samples x {k} components"
+        + (f" -> {job.output_path}" if job.output_path else "")
+    )
+    for sid, row in list(zip(out.sample_ids, out.coords))[:5]:
+        print(sid + "\t" + "\t".join(f"{v:.4g}" for v in row[:4]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

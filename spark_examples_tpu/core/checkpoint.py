@@ -1,0 +1,97 @@
+"""Partial-Gram checkpoint / resume.
+
+The reference had nothing here: a failed PCA job reran from scratch,
+recovery being Spark lineage recompute (SURVEY.md §5 "Checkpoint /
+resume", "Failure detection"). The TPU-native design does better because
+the Gram accumulation is associative: persisting (accumulators, variant
+cursor) every K blocks makes recovery "resume from the last checkpointed
+partial sum", and the same mechanism powers the streaming/incremental
+config (BASELINE.md config 5).
+
+Format: a directory with one ``.npy`` per accumulator leaf plus a JSON
+manifest (cursor, metric, block size, sample ids hash). Writes are
+atomic (tmp dir + rename) so a crash mid-write never corrupts the latest
+good checkpoint.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+
+
+def _sample_hash(sample_ids: list[str]) -> str:
+    h = hashlib.sha256("\n".join(sample_ids).encode()).hexdigest()
+    return h[:16]
+
+
+def save(
+    path: str,
+    acc: dict,
+    next_variant: int,
+    metric: str,
+    block_variants: int,
+    sample_ids: list[str],
+) -> None:
+    """Atomically persist accumulators + resume cursor."""
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    for k, v in acc.items():
+        np.save(os.path.join(tmp, f"{k}.npy"), np.asarray(v))
+    manifest = {
+        "next_variant": int(next_variant),
+        "metric": metric,
+        "block_variants": int(block_variants),
+        "sample_hash": _sample_hash(sample_ids),
+        "n_samples": len(sample_ids),
+        "leaves": sorted(acc.keys()),
+    }
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+
+
+def load(path: str, metric: str, sample_ids: list[str],
+         block_variants: int | None = None):
+    """Load (acc, next_variant) or None when absent/incompatible.
+
+    Incompatible checkpoints (different metric, cohort, or block grid)
+    are rejected rather than silently mixed into the accumulation: a
+    resume with a different ``block_variants`` would misalign the cursor
+    against the block grid and double-count or skip variants.
+    """
+    manifest_path = os.path.join(path, "manifest.json")
+    if not os.path.exists(manifest_path):
+        return None
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    if block_variants is not None and manifest["block_variants"] != block_variants:
+        raise ValueError(
+            f"checkpoint at {path} was written with --block-variants "
+            f"{manifest['block_variants']}, job wants {block_variants}; "
+            "resume must keep the same block grid"
+        )
+    if manifest["metric"] != metric:
+        raise ValueError(
+            f"checkpoint at {path} is for metric {manifest['metric']!r}, "
+            f"job wants {metric!r}"
+        )
+    if manifest["sample_hash"] != _sample_hash(sample_ids):
+        raise ValueError(
+            f"checkpoint at {path} was built for a different cohort "
+            f"({manifest['n_samples']} samples)"
+        )
+    acc = {
+        k: jax.device_put(np.load(os.path.join(path, f"{k}.npy")))
+        for k in manifest["leaves"]
+    }
+    return acc, int(manifest["next_variant"])

@@ -29,8 +29,14 @@ class ReferenceRange:
 
     @classmethod
     def parse(cls, spec: str) -> "ReferenceRange":
-        contig, start, end = spec.split(":")
-        return cls(contig, int(start), int(end))
+        try:
+            contig, start, end = spec.split(":")
+            return cls(contig, int(start), int(end))
+        except ValueError:
+            raise ValueError(
+                f"bad reference range {spec!r}: expected CONTIG:START:END "
+                "(e.g. chr22:16050000:17000000)"
+            ) from None
 
     def __str__(self) -> str:
         return f"{self.contig}:{self.start}:{self.end}"

@@ -1,0 +1,11 @@
+from spark_examples_tpu.ingest import packed, prefetch, source, synthetic, vcf  # noqa: F401
+from spark_examples_tpu.ingest.packed import load_packed, save_packed  # noqa: F401
+from spark_examples_tpu.ingest.source import (  # noqa: F401
+    ArraySource,
+    BlockMeta,
+    ChainSource,
+    GenotypeSource,
+    partition_ranges,
+)
+from spark_examples_tpu.ingest.synthetic import SyntheticSource  # noqa: F401
+from spark_examples_tpu.ingest.vcf import VcfSource, write_vcf  # noqa: F401

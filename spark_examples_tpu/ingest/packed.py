@@ -1,0 +1,59 @@
+"""Packed columnar genotype store — the BigQuery-export stand-in.
+
+The Stanford fork added a BigQuery → RDD ingestion path for
+1000-Genomes-style variant tables (SURVEY.md §2.1 "BigQuery ingestion
+path"). Its spirit — bulk columnar export consumed by the compute tier,
+bypassing the paged API — maps here to a directory holding a memmappable
+``genotypes.npy`` (N, V) int8 matrix plus a JSON sidecar of sample ids /
+positions. Reading is zero-copy block slicing of the memmap.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE
+from spark_examples_tpu.ingest.source import ArraySource
+
+
+def save_packed(
+    path: str,
+    genotypes: np.ndarray,
+    sample_ids: list[str] | None = None,
+    contig: str | None = None,
+    positions: np.ndarray | None = None,
+) -> None:
+    os.makedirs(path, exist_ok=True)
+    np.save(os.path.join(path, "genotypes.npy"),
+            np.ascontiguousarray(genotypes, dtype=GENOTYPE_DTYPE))
+    meta = {
+        "n_samples": int(genotypes.shape[0]),
+        "n_variants": int(genotypes.shape[1]),
+        "sample_ids": sample_ids,
+        "contig": contig,
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    if positions is not None:
+        np.save(os.path.join(path, "positions.npy"),
+                np.asarray(positions, np.int64))
+
+
+def load_packed(path: str, mmap: bool = True) -> ArraySource:
+    g = np.load(os.path.join(path, "genotypes.npy"),
+                mmap_mode="r" if mmap else None)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    positions = None
+    pos_path = os.path.join(path, "positions.npy")
+    if os.path.exists(pos_path):
+        positions = np.load(pos_path)
+    return ArraySource(
+        genotypes=g,
+        ids=meta.get("sample_ids"),
+        contig=meta.get("contig"),
+        positions=positions,
+    )

@@ -1,0 +1,79 @@
+"""Double-buffered host→device feeding.
+
+The reference overlapped nothing: executors paged the Genomics API inside
+``compute`` and Spark hid latency only via many concurrent tasks
+(SURVEY.md §3.5). On TPU the equivalent overlap is explicit: a background
+thread produces host blocks while the chip crunches the previous one, and
+``jax.device_put`` of block k+1 overlaps the accumulation FMA of block k
+(dispatch is async). Ragged final blocks are padded to the full block
+width with MISSING (-1), which is semantically free — a missing call
+contributes zero to every gram piece — and keeps a single compiled shape
+for the whole stream (SURVEY.md §7 step 2 "double-buffered feed").
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE, MISSING
+from spark_examples_tpu.ingest.source import BlockMeta, GenotypeSource
+
+_END = object()
+
+
+def pad_block(block: np.ndarray, block_variants: int) -> np.ndarray:
+    """Right-pad a ragged block to ``block_variants`` with MISSING."""
+    n, v = block.shape
+    if v == block_variants:
+        return block
+    out = np.full((n, block_variants), MISSING, dtype=GENOTYPE_DTYPE)
+    out[:, :v] = block
+    return out
+
+
+def stream_to_device(
+    source: GenotypeSource,
+    block_variants: int,
+    start_variant: int = 0,
+    device=None,
+    sharding=None,
+    prefetch: int = 2,
+) -> Iterator[tuple[jax.Array, BlockMeta]]:
+    """Yield device-resident, shape-stable (N, block_variants) blocks.
+
+    A daemon thread runs the (possibly slow, pure-Python/IO) source
+    iterator and fills a bounded queue; the consumer side transfers to
+    ``device`` (or places with ``sharding`` for the multi-chip path) and
+    yields. Errors in the producer propagate to the consumer.
+    """
+    q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
+
+    def produce():
+        try:
+            for block, meta in source.blocks(block_variants, start_variant):
+                q.put((pad_block(block, block_variants), meta))
+            q.put(_END)
+        except BaseException as e:  # propagate into consumer
+            q.put(e)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        host_block, meta = item
+        if sharding is not None:
+            dev_block = jax.device_put(host_block, sharding)
+        elif device is not None:
+            dev_block = jax.device_put(host_block, device)
+        else:
+            dev_block = jax.device_put(host_block)
+        yield dev_block, meta

@@ -1,0 +1,175 @@
+"""GenotypeSource — the kept-abstract replacement of the reference's
+RDD/ingest layers (L2/L3).
+
+The reference streamed variants through a custom ``VariantsRDD`` whose
+partitions each paged a Genomics-API ``searchVariants`` range, with
+genomic-range partitioners deciding the split (SURVEY.md §2.1 "Variants
+RDD", "Genomic-range partitioners"; §3.5 ``VariantsRDD.compute``). This
+framework keeps exactly that seam: anything that can yield dense int8
+dosage blocks over a sample cohort is a source — synthetic cohorts, VCF
+files, packed-array exports standing in for the BigQuery path. Compute
+never sees anything but (N, v_blk) blocks + metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Iterator, Protocol, Sequence, runtime_checkable
+
+import numpy as np
+
+from spark_examples_tpu.core.config import ReferenceRange
+
+
+@dataclass(frozen=True)
+class BlockMeta:
+    """Metadata for one streamed genotype block.
+
+    ``positions``/``contigs`` are optional per-variant annotations (the
+    serializable remnant of the reference's ``Variant`` case class —
+    SURVEY.md §2.1 "Serializable data model"); the cursor fields support
+    deterministic resume (SURVEY.md §5 "Checkpoint / resume").
+    """
+
+    index: int  # block ordinal in the stream
+    start: int  # first variant (global index, inclusive)
+    stop: int  # past-the-end variant (global index)
+    contig: str | None = None
+    positions: np.ndarray | None = None  # (v_blk,) int64, optional
+
+
+@runtime_checkable
+class GenotypeSource(Protocol):
+    """The ingest contract: sample axis fixed, variant axis streamed."""
+
+    @property
+    def n_samples(self) -> int: ...
+
+    @property
+    def n_variants(self) -> int: ...
+
+    @property
+    def sample_ids(self) -> list[str]: ...
+
+    def blocks(
+        self, block_variants: int, start_variant: int = 0
+    ) -> Iterator[tuple[np.ndarray, BlockMeta]]:
+        """Yield (int8 (n_samples, <=block_variants) dosage block, meta),
+        starting at global variant index ``start_variant`` (resume)."""
+        ...
+
+
+def partition_ranges(
+    references: Sequence[ReferenceRange], splits_per_contig: int
+) -> list[ReferenceRange]:
+    """Split genomic ranges into ~equal sub-ranges.
+
+    The TPU-native successor of the reference's ``VariantsPartitioner``
+    ``FixedContigSplits(n)`` strategy (SURVEY.md §2.1): each sub-range is
+    an independent ingest unit (the reference made one RDD partition /
+    API page-stream per sub-range; here it is a unit of host-side read
+    parallelism and the resume granularity).
+    """
+    out: list[ReferenceRange] = []
+    for ref in references:
+        span = ref.end - ref.start
+        if span <= 0 or splits_per_contig <= 1:
+            out.append(ref)
+            continue
+        step = -(-span // splits_per_contig)
+        for s in range(ref.start, ref.end, step):
+            out.append(ReferenceRange(ref.contig, s, min(s + step, ref.end)))
+    return out
+
+
+@dataclass
+class ArraySource:
+    """In-memory / memmapped (N, V) int8 matrix as a source.
+
+    Wraps ``np.load(..., mmap_mode="r")`` arrays too, which makes it the
+    packed-column-export stand-in for the reference fork's BigQuery
+    ingestion path (SURVEY.md §2.1 "BigQuery ingestion path").
+    """
+
+    genotypes: np.ndarray  # (N, V) int8
+    ids: list[str] | None = None
+    contig: str | None = None
+    positions: np.ndarray | None = None
+
+    @property
+    def n_samples(self) -> int:
+        return int(self.genotypes.shape[0])
+
+    @property
+    def n_variants(self) -> int:
+        return int(self.genotypes.shape[1])
+
+    @property
+    def sample_ids(self) -> list[str]:
+        if self.ids is not None:
+            return self.ids
+        return [f"S{i:06d}" for i in range(self.n_samples)]
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        v = self.n_variants
+        # ceil: a cursor inside/at-the-end-of a partial final block must
+        # not re-emit it (cursors are block-aligned or == n_variants).
+        first = -(-start_variant // block_variants)
+        for idx in range(first, -(-v // block_variants)):
+            lo = idx * block_variants
+            hi = min(lo + block_variants, v)
+            block = np.ascontiguousarray(self.genotypes[:, lo:hi], dtype=np.int8)
+            pos = None
+            if self.positions is not None:
+                pos = self.positions[lo:hi]
+            yield block, BlockMeta(idx, lo, hi, self.contig, pos)
+
+
+def concat_sources(sources: Sequence[GenotypeSource]) -> "ChainSource":
+    return ChainSource(list(sources))
+
+
+@dataclass
+class ChainSource:
+    """Concatenate sources along the variant axis (multi-contig cohorts:
+    one source per reference range, mirroring partitioned ingest)."""
+
+    parts: list
+
+    def __post_init__(self):
+        ns = {p.n_samples for p in self.parts}
+        if len(ns) != 1:
+            raise ValueError(f"sources disagree on n_samples: {ns}")
+
+    @property
+    def n_samples(self) -> int:
+        return self.parts[0].n_samples
+
+    @property
+    def n_variants(self) -> int:
+        return sum(p.n_variants for p in self.parts)
+
+    @property
+    def sample_ids(self) -> list[str]:
+        return self.parts[0].sample_ids
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        offset = 0
+        idx = 0
+        for part in self.parts:
+            pv = part.n_variants
+            if start_variant >= offset + pv:
+                offset += pv
+                continue
+            local_start = max(0, start_variant - offset)
+            # Align local start down to the part's own block grid.
+            for block, meta in part.blocks(block_variants, local_start):
+                yield block, dataclasses.replace(
+                    meta,
+                    index=idx,
+                    start=meta.start + offset,
+                    stop=meta.stop + offset,
+                )
+                idx += 1
+            offset += pv

@@ -1,0 +1,189 @@
+"""Streaming VCF ingest — the file-based stand-in for the reference's
+Genomics-API ``searchVariants`` page loop (SURVEY.md §3.5).
+
+A deliberately dependency-free text parser (plain or gzip VCF): header →
+sample ids; records stream in genomic order and are packed column-by-
+column into (N, v_blk) int8 dosage blocks. Any non-reference allele
+counts toward dosage (multi-allelic sites collapse to alt-carrier
+dosage), half-calls count the called allele, and ``.`` genotypes are
+missing — the semantics the reference's alt-carrier pair counting implied
+(SURVEY.md §3.1 "filter variants with >=1 non-ref call").
+
+Region filtering mirrors the reference's ``--references chr:start:end``
+flag: only records inside one of the ranges are yielded.
+"""
+
+from __future__ import annotations
+
+import gzip
+from dataclasses import dataclass, field
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from spark_examples_tpu.core.config import ReferenceRange
+from spark_examples_tpu.ingest.source import BlockMeta
+
+
+def _open(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt")
+    return open(path, "rt")
+
+
+def _dosage(gt: str) -> int:
+    """GT string -> dosage in {-1, 0, 1, 2}."""
+    # strip trailing FORMAT subfields if caller passed the whole sample col
+    alleles = gt.replace("|", "/").split("/")
+    dose = 0
+    seen = False
+    for a in alleles:
+        if a == "." or a == "":
+            continue
+        seen = True
+        if a != "0":
+            dose += 1
+    if not seen:
+        return -1
+    return min(dose, 2)
+
+
+@dataclass
+class VcfSource:
+    path: str
+    references: Sequence[ReferenceRange] = ()
+    _samples: list[str] | None = field(default=None, repr=False)
+    _n_variants: int | None = field(default=None, repr=False)
+
+    def _read_header(self) -> list[str]:
+        with _open(self.path) as f:
+            for line in f:
+                if line.startswith("#CHROM"):
+                    return line.rstrip("\n").split("\t")[9:]
+                if not line.startswith("#"):
+                    break
+        raise ValueError(f"{self.path}: no #CHROM header line")
+
+    @property
+    def sample_ids(self) -> list[str]:
+        if self._samples is None:
+            self._samples = self._read_header()
+        return self._samples
+
+    @property
+    def n_samples(self) -> int:
+        return len(self.sample_ids)
+
+    @property
+    def n_variants(self) -> int:
+        """Record count (single pre-scan, cached)."""
+        if self._n_variants is None:
+            self._n_variants = sum(1 for _ in self._records())
+        return self._n_variants
+
+    def _in_range(self, contig: str, pos: int) -> bool:
+        if not self.references:
+            return True
+        for r in self.references:
+            if r.contig == contig and r.start <= pos < r.end:
+                return True
+        return False
+
+    def _records(self) -> Iterator[tuple[str, int, list[str]]]:
+        """Yield (contig, pos, per-sample GT strings)."""
+        with _open(self.path) as f:
+            for line in f:
+                if line.startswith("#"):
+                    continue
+                fields = line.rstrip("\n").split("\t")
+                contig, pos = fields[0], int(fields[1])
+                if not self._in_range(contig, pos):
+                    continue
+                fmt = fields[8].split(":")
+                try:
+                    gt_idx = fmt.index("GT")
+                except ValueError:
+                    continue  # no genotypes at this site
+                gts = [s.split(":")[gt_idx] for s in fields[9:]]
+                yield contig, pos, gts
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        n = self.n_samples
+        cols: list[np.ndarray] = []
+        positions: list[int] = []
+        contig0: str | None = None
+        idx = -(-start_variant // block_variants)  # ceil, see ArraySource
+        emitted_start = idx * block_variants
+        seen = 0
+        gt_cache: dict[str, int] = {}
+        for contig, pos, gts in self._records():
+            if seen < emitted_start:
+                seen += 1
+                continue
+            seen += 1
+            col = np.empty(n, dtype=np.int8)
+            for i, gt in enumerate(gts):
+                d = gt_cache.get(gt)
+                if d is None:
+                    d = _dosage(gt)
+                    gt_cache[gt] = d
+                col[i] = d
+            cols.append(col)
+            positions.append(pos)
+            contig0 = contig0 or contig
+            if len(cols) == block_variants:
+                yield (
+                    np.stack(cols, axis=1),
+                    BlockMeta(
+                        idx,
+                        emitted_start,
+                        emitted_start + len(cols),
+                        contig0,
+                        np.asarray(positions, np.int64),
+                    ),
+                )
+                emitted_start += len(cols)
+                idx += 1
+                cols, positions, contig0 = [], [], None
+        if cols:
+            yield (
+                np.stack(cols, axis=1),
+                BlockMeta(
+                    idx,
+                    emitted_start,
+                    emitted_start + len(cols),
+                    contig0,
+                    np.asarray(positions, np.int64),
+                ),
+            )
+        # A completed full pass has counted every record — cache it so a
+        # later .n_variants doesn't re-parse the whole file.
+        self._n_variants = seen
+
+
+def write_vcf(
+    path: str,
+    genotypes: np.ndarray,
+    sample_ids: list[str] | None = None,
+    contig: str = "chr22",
+    start_pos: int = 16_050_000,
+) -> None:
+    """Write an (N, V) dosage matrix as a minimal diploid VCF (testing and
+    interchange; the inverse of VcfSource)."""
+    n, v = genotypes.shape
+    ids = sample_ids or [f"S{i:06d}" for i in range(n)]
+    gt_of = {-1: "./.", 0: "0/0", 1: "0/1", 2: "1/1"}
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wt") as f:
+        f.write("##fileformat=VCFv4.2\n")
+        f.write(f"##contig=<ID={contig}>\n")
+        f.write(
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\t"
+            + "\t".join(ids)
+            + "\n"
+        )
+        for j in range(v):
+            row = "\t".join(gt_of[int(g)] for g in genotypes[:, j])
+            f.write(
+                f"{contig}\t{start_pos + j}\trs{j}\tA\tC\t.\tPASS\t.\tGT\t{row}\n"
+            )

@@ -76,10 +76,14 @@ def init(n: int, metric: str) -> dict[str, jnp.ndarray]:
     return {k: jnp.zeros((n, n), jnp.float32) for k in pieces}
 
 
-@partial(jax.jit, static_argnames=("pieces",), donate_argnums=(0,))
-def _update(acc, block, pieces: tuple[str, ...]):
+def _update_impl(acc, block, pieces: tuple[str, ...]):
     g = gram_pieces(block)
     return {k: acc[k] + g[k] for k in pieces}
+
+
+_update = partial(jax.jit, static_argnames=("pieces",), donate_argnums=(0,))(
+    _update_impl
+)
 
 
 def update(acc: dict, block: jnp.ndarray, metric: str) -> dict:
@@ -90,8 +94,7 @@ def update(acc: dict, block: jnp.ndarray, metric: str) -> dict:
     return _update(acc, block, PIECES_FOR_METRIC[metric])
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def update_grm(acc: dict, block: jnp.ndarray) -> dict:
+def _update_grm_impl(acc: dict, block: jnp.ndarray) -> dict:
     """VanRaden-form GRM accumulation with in-block allele frequencies."""
     valid = (block >= 0)
     y = jnp.where(valid, block, 0).astype(jnp.float32)
@@ -105,3 +108,6 @@ def update_grm(acc: dict, block: jnp.ndarray) -> dict:
         z, z, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     return {"zz": acc["zz"] + zz, "nvar": acc["nvar"] + keep.sum()}
+
+
+update_grm = partial(jax.jit, donate_argnums=(0,))(_update_grm_impl)

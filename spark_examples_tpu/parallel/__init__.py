@@ -1,0 +1,7 @@
+from spark_examples_tpu.parallel import gram_sharded  # noqa: F401
+from spark_examples_tpu.parallel.gram_sharded import (  # noqa: F401
+    GramPlan,
+    init_sharded,
+    make_update,
+    plan_for,
+)

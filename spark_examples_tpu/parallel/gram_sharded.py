@@ -1,0 +1,134 @@
+"""Mesh-sharded Gram accumulation: the Spark shuffle, as XLA collectives.
+
+The reference's only distribution strategy was data parallelism over the
+variant axis — RDD partitions by genomic range, pair counts merged by a
+netty-shuffle ``reduceByKey`` (SURVEY.md §2.2). Its TPU-native successor
+is sharding annotations on the *same* jitted computation
+(:func:`spark_examples_tpu.ops.gram.update`):
+
+- **variant mode** (N x N fits per chip): the genotype block is sharded
+  along the variant axis over every chip in the mesh, the accumulator is
+  replicated. XLA's SPMD partitioner turns the indicator matmuls into
+  local dots over each chip's variant shard plus one ``psum`` over ICI —
+  exactly the "jax.distributed all-gather/all-reduce assembling the full
+  N x N Gram on-device" the north star prescribes (BASELINE.json:5).
+- **tile2d mode** (the 76k-exome regime, BASELINE.md config 4): the
+  accumulator is tiled (rows over mesh axis i, cols over j) so each chip
+  holds an (N/p_i, N/p_j) tile; blocks are replicated and each chip
+  contracts only its row-slice against its col-slice — no collectives in
+  the hot loop at all, communication moves to ingest broadcast.
+- **replicated mode**: single-chip degenerate case (mesh (1,1)).
+
+Mode choice is automatic from accumulator-memory footprint unless forced.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.ops import gram as gram_ops
+
+# Rough per-chip HBM budget for resident accumulators (bytes).
+_ACC_BUDGET = 8 * 2**30
+
+
+@dataclass(frozen=True)
+class GramPlan:
+    mesh: Mesh
+    mode: str  # replicated | variant | tile2d
+
+    @property
+    def acc_sharding(self) -> NamedSharding:
+        if self.mode == "tile2d":
+            return meshes.tile2d(self.mesh)
+        return meshes.replicated(self.mesh)
+
+    @property
+    def scalar_sharding(self) -> NamedSharding:
+        return meshes.replicated(self.mesh)
+
+    @property
+    def block_sharding(self) -> NamedSharding:
+        if self.mode == "variant":
+            return meshes.variants_flat(self.mesh)
+        return meshes.replicated(self.mesh)
+
+
+def plan_for(
+    mesh: Mesh, n_samples: int, metric: str, mode: str = "auto"
+) -> GramPlan:
+    """Pick a distribution mode (or validate a forced one)."""
+    if mode == "auto":
+        n_dev = mesh.devices.size
+        n_acc = max(len(gram_ops.PIECES_FOR_METRIC.get(metric, ("zz",))), 1)
+        acc_bytes = 4 * n_samples * n_samples * n_acc
+        if n_dev == 1:
+            mode = "replicated"
+        elif acc_bytes <= _ACC_BUDGET:
+            mode = "variant"
+        else:
+            mode = "tile2d"
+    if mode not in ("replicated", "variant", "tile2d"):
+        raise ValueError(f"unknown gram mode {mode!r}")
+    return GramPlan(mesh, mode)
+
+
+def _acc_shardings(plan: GramPlan, metric: str):
+    """Per-leaf shardings for the accumulator pytree (GRM has a scalar)."""
+    if metric == "grm":
+        return {"zz": plan.acc_sharding, "nvar": plan.scalar_sharding}
+    pieces = gram_ops.PIECES_FOR_METRIC[metric]
+    return {k: plan.acc_sharding for k in pieces}
+
+
+def init_sharded(plan: GramPlan, n: int, metric: str):
+    """Zero accumulators laid out per the plan."""
+    shardings = _acc_shardings(plan, metric)
+    acc = gram_ops.init(n, metric)
+    return {k: jax.device_put(v, shardings[k]) for k, v in acc.items()}
+
+
+def make_update(plan: GramPlan, metric: str):
+    """Jitted ``(acc, block) -> acc`` with the plan's shardings pinned.
+
+    The computation is byte-identical to the single-chip path; only the
+    sharding annotations differ. XLA SPMD inserts the psum (variant mode)
+    or slices the dots (tile2d) — no hand-written collectives, per the
+    mesh/annotate/let-XLA-insert recipe.
+    """
+    acc_sh = _acc_shardings(plan, metric)
+    upd = (
+        gram_ops._update_grm_impl
+        if metric == "grm"
+        else partial(gram_ops._update_impl, pieces=gram_ops.PIECES_FOR_METRIC[metric])
+    )
+    jitted = jax.jit(
+        upd,
+        in_shardings=(acc_sh, plan.block_sharding),
+        out_shardings=acc_sh,
+        donate_argnums=(0,),
+    )
+
+    n_shards = plan.mesh.devices.size if plan.mode == "variant" else 1
+
+    def update(acc, block):
+        if not (isinstance(block, jax.Array) and block.sharding == plan.block_sharding):
+            block = np.asarray(block)
+            if block.shape[1] % n_shards:
+                # Pad the variant axis to shardable width with MISSING —
+                # a missing call contributes zero to every gram piece, so
+                # this is semantically free (same trick as prefetch.pad_block).
+                from spark_examples_tpu.ingest.prefetch import pad_block
+
+                width = -(-block.shape[1] // n_shards) * n_shards
+                block = pad_block(block, width)
+            block = jax.device_put(block, plan.block_sharding)
+        return jitted(acc, block)
+
+    return update

@@ -1,0 +1,6 @@
+from spark_examples_tpu.pipelines import examples, io, jobs, runner  # noqa: F401
+from spark_examples_tpu.pipelines.jobs import (  # noqa: F401
+    pcoa_job,
+    similarity_matrix_job,
+    variants_pca_job,
+)

@@ -1,0 +1,75 @@
+"""Smoke-test-tier example jobs — the reference's ``SearchVariantsExample*``
+drivers (SURVEY.md §3.4: Klotho rs9536314 / BRCA1 genotype histograms
+across a cohort) rebuilt over the block-streaming ingest.
+
+The per-variant genotype histogram is one jitted reduction over the
+sample axis per block (4 one-hot sums), so the "search" tier rides the
+same ingest machinery as the flagship pipeline — as it did in the
+reference (same VariantsRDD, no linear-algebra tail).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _block_histogram(block: jnp.ndarray) -> jnp.ndarray:
+    """(N, v) int8 dosages -> (v, 4) counts of [hom-ref, het, hom-alt,
+    missing] across samples."""
+    return jnp.stack(
+        [
+            (block == 0).sum(axis=0),
+            (block == 1).sum(axis=0),
+            (block == 2).sum(axis=0),
+            (block == -1).sum(axis=0),
+        ],
+        axis=1,
+    )
+
+
+@dataclass
+class VariantCounts:
+    contig: str | None
+    position: int  # genomic position when known, else global index
+    hom_ref: int
+    het: int
+    hom_alt: int
+    missing: int
+
+    @property
+    def allele_freq(self) -> float:
+        called = self.hom_ref + self.het + self.hom_alt
+        return (self.het + 2 * self.hom_alt) / (2 * called) if called else 0.0
+
+
+def genotype_histogram(
+    source,
+    block_variants: int = 8192,
+    positions: set[int] | None = None,
+) -> list[VariantCounts]:
+    """Genotype histograms per variant, optionally restricted to a set of
+    genomic positions (the Klotho/BRCA1 'search' shape)."""
+    out: list[VariantCounts] = []
+    for block, meta in source.blocks(block_variants):
+        hist = None
+        for j in range(block.shape[1]):
+            pos = (
+                int(meta.positions[j])
+                if meta.positions is not None
+                else meta.start + j
+            )
+            if positions is not None and pos not in positions:
+                continue
+            if hist is None:
+                hist = np.asarray(_block_histogram(block))
+            h = hist[j]
+            out.append(
+                VariantCounts(meta.contig, pos, int(h[0]), int(h[1]),
+                              int(h[2]), int(h[3]))
+            )
+    return out

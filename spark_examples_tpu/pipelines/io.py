@@ -1,0 +1,66 @@
+"""Output writers — the reference's ``saveAsTextFile`` tail (SURVEY.md
+§2.1 "Output writers": text rows of sample-name + coordinates).
+
+Matrices are persisted with a ``<path>.meta.json`` sidecar recording the
+sample ids and whether the matrix holds similarities or distances, so the
+SimilarityMatrix -> PCoA job handoff (SURVEY.md §3.2-3.3) is
+self-describing: the PCoA job cannot silently center a similarity matrix
+as if it were distances, and ``.npy`` outputs keep their cohort labels.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def write_coords_tsv(path: str, sample_ids: list[str], coords: np.ndarray) -> None:
+    """``sample<TAB>pc1<TAB>pc2...`` — the reference's PCA output shape."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    k = coords.shape[1]
+    with open(path, "w") as f:
+        f.write("sample\t" + "\t".join(f"pc{i + 1}" for i in range(k)) + "\n")
+        for sid, row in zip(sample_ids, np.asarray(coords)):
+            f.write(sid + "\t" + "\t".join(f"{v:.6g}" for v in row) + "\n")
+
+
+def write_matrix(
+    path: str,
+    sample_ids: list[str],
+    matrix: np.ndarray,
+    kind: str | None = None,
+) -> None:
+    """Square matrix as TSV (header row of sample ids) or ``.npy``, plus
+    the self-description sidecar. ``kind``: similarity | distance."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if path.endswith(".npy"):
+        np.save(path, matrix)
+    else:
+        with open(path, "w") as f:
+            f.write("sample\t" + "\t".join(sample_ids) + "\n")
+            for sid, row in zip(sample_ids, np.asarray(matrix)):
+                f.write(sid + "\t" + "\t".join(f"{v:.6g}" for v in row) + "\n")
+    with open(path + ".meta.json", "w") as f:
+        json.dump({"kind": kind, "sample_ids": list(sample_ids)}, f)
+
+
+def read_matrix(path: str) -> tuple[list[str], np.ndarray, str | None]:
+    """Inverse of write_matrix: (sample_ids, matrix, kind-or-None)."""
+    kind = None
+    sidecar_ids = None
+    meta_path = path + ".meta.json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        kind = meta.get("kind")
+        sidecar_ids = meta.get("sample_ids")
+    if path.endswith(".npy"):
+        m = np.load(path)
+        ids = sidecar_ids or [f"S{i:06d}" for i in range(m.shape[0])]
+        return ids, m, kind
+    with open(path) as f:
+        header = f.readline().rstrip("\n").split("\t")[1:]
+        rows = [line.rstrip("\n").split("\t")[1:] for line in f]
+    return header, np.asarray(rows, dtype=np.float64), kind

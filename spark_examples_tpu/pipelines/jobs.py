@@ -1,0 +1,127 @@
+"""Job entrypoints mirroring the reference's driver surface.
+
+- :func:`similarity_matrix_job` — the Stanford fork's SimilarityMatrix
+  entrypoint (SURVEY.md §3.2): stream cohort -> persist N x N matrix.
+- :func:`pcoa_job` — the fork's PCoA entrypoint (SURVEY.md §3.3): load or
+  build a distance matrix -> double-center -> eig -> coords.
+- :func:`variants_pca_job` — the flagship ``VariantsPcaDriver``
+  (SURVEY.md §3.1): shared-alt similarity -> center -> PCs -> coords.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from spark_examples_tpu.core.config import JobConfig
+from spark_examples_tpu.core.profiling import PhaseTimer
+from spark_examples_tpu.models.pca import fit_pca
+from spark_examples_tpu.models.pcoa import fit_pcoa
+from spark_examples_tpu.ops.eigh import eigh_flops
+from spark_examples_tpu.pipelines import io as pio
+from spark_examples_tpu.pipelines.runner import SimilarityResult, run_similarity
+from spark_examples_tpu.utils import oracle
+
+
+@dataclass
+class CoordsOutput:
+    sample_ids: list[str]
+    coords: np.ndarray
+    eigenvalues: np.ndarray
+    timer: PhaseTimer
+    n_variants: int = 0
+
+
+def similarity_matrix_job(job: JobConfig, source=None) -> SimilarityResult:
+    result = run_similarity(job, source=source)
+    if job.output_path:
+        pio.write_matrix(job.output_path, result.sample_ids,
+                         result.similarity, kind="similarity")
+    return result
+
+
+def pcoa_job(
+    job: JobConfig,
+    source=None,
+    matrix_path: str | None = None,
+    matrix_kind: str = "auto",
+) -> CoordsOutput:
+    """Distance -> PCoA coords; optionally from a persisted matrix (the
+    reference fork's two-job handoff), else end-to-end.
+
+    ``matrix_kind``: whether a persisted matrix holds distances or
+    similarities (similarities are Gower-transformed first — feeding a
+    similarity matrix straight into -1/2 J D^2 J silently yields
+    degenerate coordinates). ``auto`` trusts the file's sidecar (the
+    similarity job records what it wrote) and falls back to distance.
+    """
+    k = job.compute.num_pc
+    if matrix_path is not None:
+        sample_ids, m, file_kind = pio.read_matrix(matrix_path)
+        kind = matrix_kind if matrix_kind != "auto" else (file_kind or "distance")
+        if kind == "distance":
+            dist = m
+        elif kind == "similarity":
+            from spark_examples_tpu.ops.distances import similarity_to_distance
+
+            dist = np.asarray(similarity_to_distance(m.astype(np.float32)))
+        else:
+            raise ValueError(
+                f"matrix_kind must be distance|similarity, got {kind!r}"
+            )
+        timer = PhaseTimer()
+        n_variants = 0
+    else:
+        sim = run_similarity(job, source=source)
+        sample_ids, dist, timer = sim.sample_ids, sim.distance, sim.timer
+        n_variants = sim.n_variants
+
+    n = dist.shape[0]
+    if job.compute.backend == "cpu-reference":
+        with timer.phase("eigh"):
+            coords, vals, _prop = oracle.pcoa(dist, k=k)
+    else:
+        method = _eigh_method(job.compute.eigh_mode, n)
+        with timer.phase("eigh"):
+            res = jax.block_until_ready(
+                fit_pcoa(dist.astype(np.float32), k=k, method=method)
+            )
+        coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
+    timer.add("eigh_flops", eigh_flops(n))
+    out = CoordsOutput(sample_ids, coords, vals, timer, n_variants)
+    if job.output_path:
+        pio.write_coords_tsv(job.output_path, sample_ids, coords)
+    return out
+
+
+def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
+    """The flagship driver: shared-alt similarity -> centered PCA."""
+    job = job.replace(
+        compute=dataclasses.replace(job.compute, metric="shared-alt")
+    )
+    sim = run_similarity(job, source=source)
+    k = job.compute.num_pc
+    if job.compute.backend == "cpu-reference":
+        with sim.timer.phase("eigh"):
+            coords = oracle.pca_mllib_route(sim.similarity, k=k)
+            vals = np.zeros(k)
+    else:
+        with sim.timer.phase("eigh"):
+            res = jax.block_until_ready(
+                fit_pca(sim.similarity.astype(np.float32), k=k)
+            )
+        coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
+    sim.timer.add("eigh_flops", eigh_flops(sim.similarity.shape[0]))
+    out = CoordsOutput(sim.sample_ids, coords, vals, sim.timer, sim.n_variants)
+    if job.output_path:
+        pio.write_coords_tsv(job.output_path, out.sample_ids, out.coords)
+    return out
+
+
+def _eigh_method(eigh_mode: str, n: int) -> str:
+    if eigh_mode == "auto":
+        return "randomized" if n > 16384 else "dense"
+    return {"dense": "dense", "randomized": "randomized"}[eigh_mode]

@@ -1,0 +1,206 @@
+"""The streaming job core shared by every pipeline entrypoint.
+
+Call stack mirror of the reference's ``VariantsPcaDriver.main``
+(SURVEY.md §3.1), with each Spark-shaped stage replaced by its TPU-native
+successor:
+
+    conf parse            -> core.config dataclasses
+    SparkContext          -> core.meshes (mesh + jax.distributed)
+    VariantsRDD ingest    -> ingest.GenotypeSource streaming blocks
+    pair-emit/reduceByKey -> parallel.gram_sharded accumulation (psum)
+    collect + MLlib eigh  -> on-device centering + ops.eigh
+    saveAsTextFile        -> TSV/npy writers (pipelines.io)
+
+``--backend=cpu-reference`` routes the same job through the NumPy oracle
+instead — the stand-in for the reference's Spark-MLlib baseline and the
+measured denominator of BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from spark_examples_tpu.core import checkpoint as ckpt
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.core.config import IngestConfig, JobConfig
+from spark_examples_tpu.core.profiling import PhaseTimer
+from spark_examples_tpu.ingest import (
+    ArraySource,
+    SyntheticSource,
+    VcfSource,
+    load_packed,
+)
+from spark_examples_tpu.ingest.prefetch import stream_to_device
+from spark_examples_tpu.ops import distances, gram
+from spark_examples_tpu.parallel import gram_sharded
+from spark_examples_tpu.utils import oracle
+
+
+def build_source(cfg: IngestConfig):
+    """IngestConfig -> GenotypeSource (the reference's L2/L3 factory)."""
+    if cfg.source == "synthetic":
+        return SyntheticSource(
+            n_samples=cfg.n_samples,
+            n_variants=cfg.n_variants,
+            n_populations=cfg.n_populations,
+            seed=cfg.seed,
+        )
+    if cfg.source == "vcf":
+        if not cfg.path:
+            raise ValueError("vcf source requires ingest.path")
+        return VcfSource(cfg.path, references=tuple(cfg.references))
+    if cfg.source == "packed":
+        if not cfg.path:
+            raise ValueError("packed source requires ingest.path")
+        return load_packed(cfg.path)
+    raise ValueError(f"unknown source {cfg.source!r}")
+
+
+@dataclass
+class SimilarityResult:
+    similarity: np.ndarray
+    distance: np.ndarray
+    sample_ids: list[str]
+    metric: str
+    timer: PhaseTimer
+    n_variants: int
+
+
+def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
+    """Stream the cohort and produce the pairwise similarity + distance
+    matrices (the SimilarityMatrix job surface, SURVEY.md §3.2)."""
+    timer = PhaseTimer()
+    cfg = job.compute
+    if source is None:
+        with timer.phase("ingest_setup"):
+            source = build_source(job.ingest)
+    n = source.n_samples
+    metric = cfg.metric
+
+    if metric == "braycurtis":
+        return _run_braycurtis(job, source, timer)
+
+    if cfg.backend == "cpu-reference":
+        return _run_similarity_cpu(job, source, timer)
+
+    meshes.maybe_init_distributed()
+    mesh = meshes.make_mesh(shape=cfg.mesh_shape)
+    plan = gram_sharded.plan_for(mesh, n, metric, cfg.gram_mode)
+    update = gram_sharded.make_update(plan, metric)
+
+    bv = job.ingest.block_variants
+    start_variant = 0
+    acc = None
+    if cfg.checkpoint_dir:
+        restored = ckpt.load(cfg.checkpoint_dir, metric, source.sample_ids,
+                             block_variants=bv)
+        if restored is not None:
+            acc, start_variant = restored
+    if acc is None:
+        acc = gram_sharded.init_sharded(plan, n, metric)
+
+    blocks_done = 0
+    last_stop = start_variant
+    with timer.phase("gram"):
+        for block, meta in stream_to_device(
+            source, bv, start_variant, sharding=plan.block_sharding
+        ):
+            acc = update(acc, block)
+            timer.add("gram_flops", gram.flops_per_block(n, block.shape[1], metric))
+            timer.add("ingest_bytes", block.size)
+            blocks_done += 1
+            last_stop = meta.stop
+            if (
+                cfg.checkpoint_dir
+                and cfg.checkpoint_every_blocks
+                and blocks_done % cfg.checkpoint_every_blocks == 0
+            ):
+                jax.block_until_ready(acc)
+                ckpt.save(
+                    cfg.checkpoint_dir, acc, meta.stop, metric, bv,
+                    source.sample_ids,
+                )
+        acc = jax.block_until_ready(acc)
+
+    with timer.phase("finalize"):
+        out = jax.block_until_ready(distances.finalize(acc, metric))
+    # The stream already counted the variants (meta.stop of the final
+    # block) — avoid source.n_variants, which for VCF may re-parse the file.
+    n_variants = last_stop if last_stop > 0 else source.n_variants
+    return SimilarityResult(
+        similarity=np.asarray(out["similarity"]),
+        distance=np.asarray(out["distance"]),
+        sample_ids=source.sample_ids,
+        metric=metric,
+        timer=timer,
+        n_variants=n_variants,
+    )
+
+
+def _materialize(source, block_variants: int) -> np.ndarray:
+    blocks = [b for b, _ in source.blocks(block_variants)]
+    return np.concatenate(blocks, axis=1)
+
+
+def _run_braycurtis(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResult:
+    """Bray-Curtis path: dense (N, F) abundance table, blocked elementwise
+    kernel (BASELINE.md config 3). The dosage matrix doubles as the count
+    table when the source is genotypes."""
+    with timer.phase("ingest"):
+        x = _materialize(source, job.ingest.block_variants)
+        x = np.maximum(x, 0)  # missing (-1) counts as absence
+    if job.compute.backend == "cpu-reference":
+        with timer.phase("distance"):
+            d = oracle.cpu_braycurtis(x)
+    else:
+        with timer.phase("distance"):
+            d = np.asarray(jax.block_until_ready(distances.braycurtis(x)))
+    return SimilarityResult(
+        similarity=1.0 - d,
+        distance=d,
+        sample_ids=source.sample_ids,
+        metric="braycurtis",
+        timer=timer,
+        n_variants=source.n_variants,
+    )
+
+
+def _run_similarity_cpu(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResult:
+    """The measured CPU baseline (stand-in for Spark MLlib, SURVEY.md §5)."""
+    metric = job.compute.metric
+    n = source.n_samples
+    if metric == "grm":
+        with timer.phase("gram"):
+            x = _materialize(source, job.ingest.block_variants)
+            g = oracle.naive_grm(x)
+        return SimilarityResult(
+            similarity=g,
+            distance=np.asarray(distances.similarity_to_distance(g)),
+            sample_ids=source.sample_ids,
+            metric=metric,
+            timer=timer,
+            n_variants=source.n_variants,
+        )
+    needed = gram.PIECES_FOR_METRIC[metric]
+    acc = {k: np.zeros((n, n)) for k in needed}
+    with timer.phase("gram"):
+        for block, _meta in source.blocks(job.ingest.block_variants):
+            pieces = oracle.cpu_gram_pieces(block, pieces=needed)
+            for k in acc:
+                acc[k] += pieces[k]
+            timer.add(
+                "gram_flops", gram.flops_per_block(n, block.shape[1], metric)
+            )
+    with timer.phase("finalize"):
+        out = oracle.cpu_finalize(acc, metric)
+    return SimilarityResult(
+        similarity=out["similarity"],
+        distance=out["distance"],
+        sample_ids=source.sample_ids,
+        metric=metric,
+        timer=timer,
+        n_variants=source.n_variants,
+    )

@@ -107,31 +107,90 @@ def pca_mllib_route(similarity: np.ndarray, k: int = 10):
 
 # --------------------------------------------------------- cpu backend
 
-def cpu_gram_pieces(genotypes: np.ndarray):
-    """Vectorized NumPy mirror of ops.genotype.gram_pieces (f64)."""
+# Indicator products each gram piece needs (mirrors the DCE the jitted
+# TPU path gets for free) — keeps the measured CPU baseline honest by not
+# doing matmuls the metric never uses.
+_PIECE_PRODUCTS = {
+    "m": ("cc",),
+    "s": ("t1t1",),
+    "d1": ("t1c", "t2c", "t1t1", "t2t2"),
+    "ibs2": ("cc", "t1c", "t1t1", "t1t2", "t2t2"),
+    "dot": ("t1t1", "t1t2", "t2t2"),
+    "e2": ("t1c", "t2c", "t1t1", "t1t2", "t2t2"),
+}
+
+
+def cpu_gram_pieces(genotypes: np.ndarray, pieces: tuple[str, ...] | None = None):
+    """Vectorized NumPy mirror of ops.genotype.gram_pieces (f64).
+
+    ``pieces`` restricts both the outputs and the underlying indicator
+    matmuls to what the requested statistics need.
+    """
+    if pieces is None:
+        pieces = ("m", "s", "d1", "ibs2", "dot", "e2")
     g = genotypes
     c = (g >= 0).astype(np.float64)
     t1 = (g >= 1).astype(np.float64)
     t2 = (g >= 2).astype(np.float64)
-    cc = c @ c.T
-    t1c = t1 @ c.T
-    t2c = t2 @ c.T
-    t1t1 = t1 @ t1.T
-    t1t2 = t1 @ t2.T
-    t2t2 = t2 @ t2.T
-    a = t1c + t2c
-    p = t1t1 + t2t2
-    d1 = a + a.T - 2.0 * p
-    ibs2 = cc - t1c - t1c.T + 2.0 * t1t1 - t1t2 - t1t2.T + 2.0 * t2t2
-    dot = t1t1 + t1t2 + t1t2.T + t2t2
-    q = t1c + 3.0 * t2c
-    e2 = q + q.T - 2.0 * dot
-    return {"m": cc, "s": t1t1, "d1": d1, "ibs2": ibs2, "dot": dot, "e2": e2}
+    ops = {"cc": (c, c), "t1c": (t1, c), "t2c": (t2, c),
+           "t1t1": (t1, t1), "t1t2": (t1, t2), "t2t2": (t2, t2)}
+    needed = {p for piece in pieces for p in _PIECE_PRODUCTS[piece]}
+    prod = {name: a @ b.T for name, (a, b) in ops.items() if name in needed}
+
+    out = {}
+    for piece in pieces:
+        if piece == "m":
+            out["m"] = prod["cc"]
+        elif piece == "s":
+            out["s"] = prod["t1t1"]
+        elif piece == "d1":
+            a = prod["t1c"] + prod["t2c"]
+            p = prod["t1t1"] + prod["t2t2"]
+            out["d1"] = a + a.T - 2.0 * p
+        elif piece == "ibs2":
+            out["ibs2"] = (
+                prod["cc"] - prod["t1c"] - prod["t1c"].T
+                + 2.0 * prod["t1t1"] - prod["t1t2"] - prod["t1t2"].T
+                + 2.0 * prod["t2t2"]
+            )
+        elif piece == "dot":
+            out["dot"] = (
+                prod["t1t1"] + prod["t1t2"] + prod["t1t2"].T + prod["t2t2"]
+            )
+        elif piece == "e2":
+            dot = prod["t1t1"] + prod["t1t2"] + prod["t1t2"].T + prod["t2t2"]
+            q = prod["t1c"] + 3.0 * prod["t2c"]
+            out["e2"] = q + q.T - 2.0 * dot
+    return out
 
 
 def cpu_ibs_distance(genotypes: np.ndarray) -> np.ndarray:
     p = cpu_gram_pieces(genotypes)
     return np.where(p["m"] > 0, p["d1"] / (2.0 * p["m"]), 0.0)
+
+
+def cpu_finalize(acc: dict, metric: str) -> dict:
+    """NumPy mirror of ops.distances.finalize for the cpu-reference
+    backend (same pinned conventions)."""
+
+    def gower(s):
+        diag = np.diagonal(s)
+        return np.sqrt(np.maximum(diag[:, None] + diag[None, :] - 2 * s, 0.0))
+
+    if metric == "ibs":
+        dist = np.where(acc["m"] > 0, acc["d1"] / (2.0 * acc["m"]), 0.0)
+        return {"similarity": 1.0 - dist, "distance": dist}
+    if metric == "ibs2":
+        sim = np.where(acc["m"] > 0, acc["ibs2"] / acc["m"], 1.0)
+        return {"similarity": sim, "distance": 1.0 - sim}
+    if metric == "shared-alt":
+        return {"similarity": acc["s"], "distance": gower(acc["s"])}
+    if metric == "euclidean":
+        d = np.sqrt(np.maximum(acc["e2"], 0.0))
+        return {"similarity": -d, "distance": d}
+    if metric == "dot":
+        return {"similarity": acc["dot"], "distance": gower(acc["dot"])}
+    raise ValueError(f"unknown metric {metric!r}")
 
 
 def cpu_braycurtis(x: np.ndarray) -> np.ndarray:

@@ -1,0 +1,77 @@
+import numpy as np
+
+from spark_examples_tpu.cli.main import main
+from spark_examples_tpu.pipelines.io import read_matrix, write_matrix
+
+
+def _run(capsys, *argv):
+    rc = main(list(argv))
+    assert rc == 0
+    return capsys.readouterr()
+
+
+BASE = ["--n-samples", "24", "--n-variants", "1500", "--block-variants", "512"]
+
+
+def test_cli_pcoa_writes_coords(tmp_path, capsys):
+    out = str(tmp_path / "coords.tsv")
+    cap = _run(capsys, "pcoa", *BASE, "--num-pc", "3", "--output-path", out)
+    assert "24 samples x 3 components" in cap.out
+    rows = open(out).read().strip().splitlines()
+    assert rows[0] == "sample\tpc1\tpc2\tpc3"
+    assert len(rows) == 25
+
+
+def test_cli_similarity_then_pcoa_from_matrix(tmp_path, capsys):
+    m = str(tmp_path / "sim.tsv")
+    _run(capsys, "similarity", *BASE, "--metric", "ibs", "--output-path", m)
+    ids, sim, kind = read_matrix(m)
+    assert sim.shape == (24, 24)
+    assert kind == "similarity"  # self-describing sidecar
+    # PCoA consuming the persisted similarity directly: the sidecar tells
+    # it to Gower-transform (the naive handoff that used to be degenerate).
+    out = str(tmp_path / "coords.tsv")
+    cap = _run(capsys, "pcoa", "--matrix-path", m, "--num-pc", "2",
+               "--output-path", out)
+    assert "2 components" in cap.out
+    # explicit distance matrix still accepted
+    d = str(tmp_path / "dist.tsv")
+    write_matrix(d, ids, 1.0 - sim, kind="distance")
+    cap = _run(capsys, "pcoa", "--matrix-path", d, "--num-pc", "2")
+    assert "2 components" in cap.out
+
+
+def test_cli_npy_matrix_keeps_sample_ids(tmp_path, capsys):
+    m = str(tmp_path / "sim.npy")
+    _run(capsys, "similarity", *BASE, "--metric", "ibs", "--output-path", m)
+    ids, sim, kind = read_matrix(m)
+    assert kind == "similarity"
+    assert ids[0].startswith("P")  # real cohort ids, not fabricated S000000
+
+
+def test_cli_pca_cpu_backend(tmp_path, capsys):
+    cap = _run(capsys, "pca", *BASE, "--backend", "cpu-reference",
+               "--num-pc", "2")
+    assert "24 samples x 2 components" in cap.out
+
+
+def test_cli_search_variants(capsys):
+    cap = _run(capsys, "search-variants", *BASE, "--positions", "3", "7")
+    lines = [l for l in cap.out.splitlines() if l.strip()]
+    assert len(lines) == 2
+    assert "af=" in lines[0]
+
+
+def test_cli_vcf_source(tmp_path, capsys):
+    from spark_examples_tpu.ingest import write_vcf
+
+    rng = np.random.default_rng(0)
+    g = rng.integers(0, 3, (10, 50)).astype(np.int8)
+    path = str(tmp_path / "t.vcf")
+    write_vcf(path, g, contig="chr22", start_pos=100)
+    cap = _run(capsys, "similarity", "--source", "vcf", "--path", path,
+               "--metric", "ibs", "--block-variants", "16")
+    assert "10x10 over 50 variants" in cap.out
+    cap = _run(capsys, "search-variants", "--source", "vcf", "--path", path,
+               "--positions", "100")
+    assert cap.out.startswith("chr22:100")

@@ -1,0 +1,164 @@
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core.config import ReferenceRange
+from spark_examples_tpu.ingest import (
+    ArraySource,
+    ChainSource,
+    SyntheticSource,
+    VcfSource,
+    load_packed,
+    partition_ranges,
+    save_packed,
+    write_vcf,
+)
+from spark_examples_tpu.ingest.prefetch import pad_block, stream_to_device
+from spark_examples_tpu.ingest.vcf import _dosage
+from tests.conftest import random_genotypes
+
+
+def _materialize(source, block_variants, start=0):
+    blocks = [b for b, _ in source.blocks(block_variants, start)]
+    return np.concatenate(blocks, axis=1) if blocks else None
+
+
+def test_array_source_roundtrip(genotypes):
+    src = ArraySource(genotypes)
+    out = _materialize(src, 64)
+    np.testing.assert_array_equal(out, genotypes)
+    assert src.n_samples == genotypes.shape[0]
+    assert len(src.sample_ids) == src.n_samples
+
+
+def test_synthetic_block_size_invariance():
+    src = SyntheticSource(n_samples=20, n_variants=3000, seed=7)
+    a = _materialize(src, 512)
+    b = _materialize(src, 1536)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (20, 3000)
+    assert a.min() >= -1 and a.max() <= 2
+
+
+def test_synthetic_has_population_structure():
+    src = SyntheticSource(n_samples=60, n_variants=4000, n_populations=2,
+                          fst=0.3, seed=3)
+    g = _materialize(src, 4000).astype(float)
+    g[g < 0] = np.nan
+    # mean dosage per variant differs between the two planted populations
+    pops = src.populations
+    d = np.nanmean(g[pops == 0], 0) - np.nanmean(g[pops == 1], 0)
+    assert np.nanstd(d) > 0.2  # visible drift
+
+
+def test_synthetic_resume_matches(genotypes):
+    src = SyntheticSource(n_samples=10, n_variants=2048, seed=1)
+    full = [m.start for _, m in src.blocks(256)]
+    resumed = [m.start for _, m in src.blocks(256, start_variant=1024)]
+    assert resumed == full[4:]
+    b_full = list(src.blocks(256))[4][0]
+    b_res = next(iter(src.blocks(256, start_variant=1024)))[0]
+    np.testing.assert_array_equal(b_full, b_res)
+
+
+def test_vcf_roundtrip(tmp_path, genotypes):
+    path = str(tmp_path / "toy.vcf")
+    write_vcf(path, genotypes)
+    src = VcfSource(path)
+    assert src.n_samples == genotypes.shape[0]
+    assert src.n_variants == genotypes.shape[1]
+    out = _materialize(src, 50)
+    np.testing.assert_array_equal(out, genotypes)
+
+
+def test_vcf_gz_and_region_filter(tmp_path, genotypes):
+    path = str(tmp_path / "toy.vcf.gz")
+    write_vcf(path, genotypes, contig="chr1", start_pos=100)
+    v = genotypes.shape[1]
+    src = VcfSource(path, references=[ReferenceRange("chr1", 100, 100 + v // 2)])
+    out = _materialize(src, 32)
+    np.testing.assert_array_equal(out, genotypes[:, : v // 2])
+
+
+@pytest.mark.parametrize(
+    "gt,want",
+    [("0/0", 0), ("0|1", 1), ("1/1", 2), ("./.", -1), (".", -1),
+     ("1/.", 1), ("2|1", 2), ("1/2", 2), ("0/2", 1), ("0", 0), ("1", 1)],
+)
+def test_dosage_semantics(gt, want):
+    assert _dosage(gt) == want
+
+
+def test_packed_roundtrip(tmp_path, genotypes):
+    p = str(tmp_path / "packed")
+    save_packed(p, genotypes, sample_ids=[f"x{i}" for i in range(genotypes.shape[0])])
+    src = load_packed(p)
+    np.testing.assert_array_equal(_materialize(src, 33), genotypes)
+    assert src.sample_ids[0] == "x0"
+
+
+def test_chain_source(genotypes):
+    a = ArraySource(genotypes[:, :100])
+    b = ArraySource(genotypes[:, 100:])
+    chain = ChainSource([a, b])
+    assert chain.n_variants == genotypes.shape[1]
+    np.testing.assert_array_equal(_materialize(chain, 64), genotypes)
+
+
+def test_partition_ranges():
+    ranges = partition_ranges([ReferenceRange("chr1", 0, 1000)], 4)
+    assert len(ranges) == 4
+    assert ranges[0].start == 0 and ranges[-1].end == 1000
+    spans = [(r.end - r.start) for r in ranges]
+    assert sum(spans) == 1000
+
+
+def test_resume_cursor_inside_partial_final_block(genotypes):
+    """A cursor at the end of a ragged final block must not re-emit it."""
+    g = genotypes[:, :150]  # not a multiple of 64: final block is [128,150)
+    src = ArraySource(g)
+    metas = [m for _, m in src.blocks(64)]
+    assert metas[-1].stop == 150
+    assert list(src.blocks(64, start_variant=150)) == []
+    # aligned cursor resumes at the partial block exactly once
+    resumed = [m.start for _, m in src.blocks(64, start_variant=128)]
+    assert resumed == [128]
+
+
+def test_pad_block_is_missing(genotypes):
+    padded = pad_block(genotypes[:, :10], 16)
+    assert padded.shape == (genotypes.shape[0], 16)
+    assert (padded[:, 10:] == -1).all()
+
+
+def test_stream_to_device_pads_and_orders(genotypes):
+    src = ArraySource(genotypes)
+    blocks = list(stream_to_device(src, 64))
+    assert all(b.shape == (genotypes.shape[0], 64) for b, _ in blocks)
+    assert [m.index for _, m in blocks] == list(range(len(blocks)))
+    # padding with MISSING leaves gram counts unchanged
+    from spark_examples_tpu.ops import gram
+
+    acc = gram.init(genotypes.shape[0], "ibs")
+    for b, _ in blocks:
+        acc = gram.update(acc, b, "ibs")
+    from spark_examples_tpu.ops.genotype import gram_pieces
+
+    whole = gram_pieces(genotypes)
+    np.testing.assert_array_equal(np.asarray(acc["m"]), np.asarray(whole["m"]))
+    np.testing.assert_array_equal(np.asarray(acc["d1"]), np.asarray(whole["d1"]))
+
+
+def test_stream_to_device_propagates_errors():
+    class Bad:
+        n_samples = 3
+        n_variants = 10
+        sample_ids = ["a", "b", "c"]
+
+        def blocks(self, bv, start_variant=0):
+            yield np.zeros((3, bv), np.int8), None
+            raise RuntimeError("boom")
+
+    it = stream_to_device(Bad(), 4)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)

@@ -1,0 +1,130 @@
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core.config import ComputeConfig, IngestConfig, JobConfig
+from spark_examples_tpu.ingest import ArraySource
+from spark_examples_tpu.pipelines import jobs, runner
+from spark_examples_tpu.pipelines.examples import genotype_histogram
+from spark_examples_tpu.utils import oracle
+from tests.conftest import random_genotypes
+
+
+def _job(**kw):
+    ingest = IngestConfig(
+        source="synthetic", n_samples=40, n_variants=2000,
+        block_variants=512, seed=5, n_populations=3,
+    )
+    compute = ComputeConfig(**kw)
+    return JobConfig(ingest=ingest, compute=compute)
+
+
+def test_similarity_tpu_vs_cpu_backend_agree():
+    """The --backend gate: both backends produce the same matrices."""
+    tpu = runner.run_similarity(_job(metric="ibs", backend="jax-tpu"))
+    cpu = runner.run_similarity(_job(metric="ibs", backend="cpu-reference"))
+    np.testing.assert_allclose(tpu.distance, cpu.distance, rtol=1e-5, atol=1e-6)
+    assert tpu.sample_ids == cpu.sample_ids
+
+
+def test_pcoa_job_end_to_end_recovers_structure():
+    job = _job(metric="ibs", num_pc=4)
+    out = jobs.pcoa_job(job)
+    assert out.coords.shape == (40, 4)
+    # planted 3-population structure: PC1/2 separate clusters
+    from spark_examples_tpu.pipelines.runner import build_source
+
+    src = build_source(job.ingest)
+    pops = src.populations
+    coords = out.coords[:, :2]
+    cents = np.stack([coords[pops == k].mean(0) for k in range(3)])
+    within = np.mean(
+        [np.linalg.norm(coords[i] - cents[pops[i]]) for i in range(40)]
+    )
+    between = np.mean(
+        [np.linalg.norm(cents[a] - cents[b]) for a in range(3) for b in range(a + 1, 3)]
+    )
+    assert between / within > 3.0
+
+
+def test_variants_pca_job_matches_mllib_route():
+    out_tpu = jobs.variants_pca_job(_job(backend="jax-tpu", num_pc=3))
+    out_cpu = jobs.variants_pca_job(_job(backend="cpu-reference", num_pc=3))
+    for c in range(3):
+        a, b = out_tpu.coords[:, c], out_cpu.coords[:, c]
+        assert np.allclose(a, b, atol=1e-2 * np.abs(a).max()) or np.allclose(
+            a, -b, atol=1e-2 * np.abs(a).max()
+        )
+
+
+def test_braycurtis_pipeline(rng):
+    g = np.abs(random_genotypes(rng, 20, 300, missing_rate=0.2))
+    src = ArraySource(g)
+    res = runner.run_similarity(
+        JobConfig(ingest=IngestConfig(block_variants=128),
+                  compute=ComputeConfig(metric="braycurtis")),
+        source=src,
+    )
+    want = oracle.cpu_braycurtis(np.maximum(g, 0))
+    np.testing.assert_allclose(res.distance, want, rtol=1e-5, atol=1e-6)
+
+
+def test_checkpoint_resume(tmp_path, rng):
+    """Kill-and-resume: second run continues from the cursor and matches
+    an uninterrupted run."""
+    g = random_genotypes(rng, 16, 1024, missing_rate=0.1)
+    src = ArraySource(g)
+    ckpt_dir = str(tmp_path / "ck")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=128),
+        compute=ComputeConfig(
+            metric="ibs", checkpoint_dir=ckpt_dir, checkpoint_every_blocks=2
+        ),
+    )
+
+    # simulate a crash: a source that dies after 4 blocks
+    class Dying(ArraySource):
+        def blocks(self, bv, start_variant=0):
+            for i, (b, m) in enumerate(super().blocks(bv, start_variant)):
+                if i == 4:
+                    raise RuntimeError("simulated preemption")
+                yield b, m
+
+    with pytest.raises(RuntimeError, match="preemption"):
+        runner.run_similarity(job, source=Dying(g))
+
+    resumed = runner.run_similarity(job, source=src)
+    clean = runner.run_similarity(
+        JobConfig(ingest=IngestConfig(block_variants=128),
+                  compute=ComputeConfig(metric="ibs")),
+        source=src,
+    )
+    np.testing.assert_allclose(resumed.distance, clean.distance,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_rejects_wrong_cohort(tmp_path, rng):
+    from spark_examples_tpu.core import checkpoint as ckpt
+
+    g = random_genotypes(rng, 8, 64)
+    ckpt.save(str(tmp_path / "c"), {"m": np.zeros((8, 8))}, 64, "ibs", 64,
+              [f"s{i}" for i in range(8)])
+    with pytest.raises(ValueError, match="different cohort"):
+        ckpt.load(str(tmp_path / "c"), "ibs", [f"other{i}" for i in range(8)])
+    with pytest.raises(ValueError, match="metric"):
+        ckpt.load(str(tmp_path / "c"), "grm", [f"s{i}" for i in range(8)])
+
+
+def test_genotype_histogram(rng):
+    g = random_genotypes(rng, 30, 100, missing_rate=0.2)
+    src = ArraySource(g)
+    counts = genotype_histogram(src, block_variants=32)
+    assert len(counts) == 100
+    for j in (0, 57, 99):
+        c = counts[j]
+        col = g[:, j]
+        assert c.hom_ref == (col == 0).sum()
+        assert c.het == (col == 1).sum()
+        assert c.hom_alt == (col == 2).sum()
+        assert c.missing == (col == -1).sum()
+    sel = genotype_histogram(src, block_variants=32, positions={5, 7})
+    assert [c.position for c in sel] == [5, 7]
