@@ -123,6 +123,21 @@ def main(argv: list[str] | None = None) -> int:
     job = _job_from_args(args)
 
     # Imports deferred so --help stays instant (no jax/TPU init).
+    import os
+
+    import jax
+
+    # Persistent compile cache: first-run jit of the big kernels (eigh
+    # especially) costs tens of seconds on TPU; cache across invocations.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "SPARK_EXAMPLES_TPU_CACHE",
+            os.path.expanduser("~/.cache/spark_examples_tpu/jax"),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from spark_examples_tpu.pipelines import jobs as J
     from spark_examples_tpu.pipelines.runner import build_source
 
@@ -149,6 +164,18 @@ def main(argv: list[str] | None = None) -> int:
         src = build_source(job.ingest)
         positions = set(args.positions) if args.positions else None
         counts = genotype_histogram(src, job.ingest.block_variants, positions)
+        if job.output_path:  # full results, not just the console preview
+            import os as _os
+
+            _os.makedirs(_os.path.dirname(job.output_path) or ".", exist_ok=True)
+            with open(job.output_path, "w") as f:
+                f.write("contig\tposition\thom_ref\thet\thom_alt\tmissing\taf\n")
+                for c in counts:
+                    f.write(
+                        f"{c.contig or '?'}\t{c.position}\t{c.hom_ref}\t"
+                        f"{c.het}\t{c.hom_alt}\t{c.missing}\t"
+                        f"{c.allele_freq:.6f}\n"
+                    )
         for c in counts[:50]:
             print(
                 f"{c.contig or '?'}:{c.position}\t0/0={c.hom_ref}\t"
@@ -156,7 +183,10 @@ def main(argv: list[str] | None = None) -> int:
                 f"af={c.allele_freq:.4f}"
             )
         if len(counts) > 50:
-            print(f"... {len(counts) - 50} more variants")
+            tail = f"... {len(counts) - 50} more variants"
+            if job.output_path:
+                tail += f" (full table in {job.output_path})"
+            print(tail)
         return 0
     else:  # pragma: no cover
         parser.error(f"unknown command {args.command}")

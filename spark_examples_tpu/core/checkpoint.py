@@ -55,9 +55,17 @@ def save(
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f)
+    # Never a window with zero good checkpoints: move the old one aside,
+    # land the new one, then delete the old. A crash mid-sequence leaves
+    # either `path` or `path.old` intact (load() checks both).
+    old = path + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
     if os.path.exists(path):
-        shutil.rmtree(path)
+        os.replace(path, old)
     os.replace(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def load(path: str, metric: str, sample_ids: list[str],
@@ -71,7 +79,13 @@ def load(path: str, metric: str, sample_ids: list[str],
     """
     manifest_path = os.path.join(path, "manifest.json")
     if not os.path.exists(manifest_path):
-        return None
+        # Crash window fallback: the previous good checkpoint was moved
+        # aside but the new one never landed.
+        old = path + ".old"
+        if os.path.exists(os.path.join(old, "manifest.json")):
+            path, manifest_path = old, os.path.join(old, "manifest.json")
+        else:
+            return None
     with open(manifest_path) as f:
         manifest = json.load(f)
     if block_variants is not None and manifest["block_variants"] != block_variants:

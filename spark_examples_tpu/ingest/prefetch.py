@@ -43,37 +43,59 @@ def stream_to_device(
     device=None,
     sharding=None,
     prefetch: int = 2,
+    pad_multiple: int = 1,
 ) -> Iterator[tuple[jax.Array, BlockMeta]]:
-    """Yield device-resident, shape-stable (N, block_variants) blocks.
+    """Yield device-resident, shape-stable (N, padded_width) blocks.
 
     A daemon thread runs the (possibly slow, pure-Python/IO) source
     iterator and fills a bounded queue; the consumer side transfers to
     ``device`` (or places with ``sharding`` for the multi-chip path) and
-    yields. Errors in the producer propagate to the consumer.
+    yields. Errors in the producer propagate to the consumer; abandoning
+    the generator early (caller raises / breaks) stops the producer
+    instead of leaving it blocked on the full queue with the source open.
+
+    ``pad_multiple``: additionally round the padded width up to this
+    multiple — variant-sharded placement needs the variant axis divisible
+    by the mesh size.
     """
     q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
+    stop = threading.Event()
+    width = -(-block_variants // pad_multiple) * pad_multiple
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def produce():
         try:
             for block, meta in source.blocks(block_variants, start_variant):
-                q.put((pad_block(block, block_variants), meta))
-            q.put(_END)
+                if not _put((pad_block(block, width), meta)):
+                    return
+            _put(_END)
         except BaseException as e:  # propagate into consumer
-            q.put(e)
+            _put(e)
 
     t = threading.Thread(target=produce, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        host_block, meta = item
-        if sharding is not None:
-            dev_block = jax.device_put(host_block, sharding)
-        elif device is not None:
-            dev_block = jax.device_put(host_block, device)
-        else:
-            dev_block = jax.device_put(host_block)
-        yield dev_block, meta
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            host_block, meta = item
+            if sharding is not None:
+                dev_block = jax.device_put(host_block, sharding)
+            elif device is not None:
+                dev_block = jax.device_put(host_block, device)
+            else:
+                dev_block = jax.device_put(host_block)
+            yield dev_block, meta
+    finally:
+        stop.set()

@@ -108,19 +108,48 @@ class VcfSource:
                 yield contig, pos, gts
 
     def blocks(self, block_variants: int, start_variant: int = 0):
+        """Stream (N, <=block_variants) blocks.
+
+        Blocks never span a contig boundary (a boundary flushes the
+        current partial block), so ``BlockMeta.contig`` is exact for
+        every variant in the block. Consequently the resume cursor is a
+        plain record ordinal — any ``start_variant`` a previous stream's
+        ``meta.stop`` produced is valid, aligned or not.
+        """
         n = self.n_samples
         cols: list[np.ndarray] = []
         positions: list[int] = []
-        contig0: str | None = None
-        idx = -(-start_variant // block_variants)  # ceil, see ArraySource
-        emitted_start = idx * block_variants
+        cur_contig: str | None = None
+        idx = 0
+        emitted_start = start_variant
         seen = 0
         gt_cache: dict[str, int] = {}
+
+        def flush():
+            nonlocal cols, positions, idx, emitted_start
+            block = (
+                np.stack(cols, axis=1),
+                BlockMeta(
+                    idx,
+                    emitted_start,
+                    emitted_start + len(cols),
+                    cur_contig,
+                    np.asarray(positions, np.int64),
+                ),
+            )
+            emitted_start += len(cols)
+            idx += 1
+            cols, positions = [], []
+            return block
+
         for contig, pos, gts in self._records():
-            if seen < emitted_start:
+            if seen < start_variant:
                 seen += 1
                 continue
             seen += 1
+            if cols and (len(cols) == block_variants or contig != cur_contig):
+                yield flush()
+            cur_contig = contig
             col = np.empty(n, dtype=np.int8)
             for i, gt in enumerate(gts):
                 d = gt_cache.get(gt)
@@ -130,32 +159,8 @@ class VcfSource:
                 col[i] = d
             cols.append(col)
             positions.append(pos)
-            contig0 = contig0 or contig
-            if len(cols) == block_variants:
-                yield (
-                    np.stack(cols, axis=1),
-                    BlockMeta(
-                        idx,
-                        emitted_start,
-                        emitted_start + len(cols),
-                        contig0,
-                        np.asarray(positions, np.int64),
-                    ),
-                )
-                emitted_start += len(cols)
-                idx += 1
-                cols, positions, contig0 = [], [], None
         if cols:
-            yield (
-                np.stack(cols, axis=1),
-                BlockMeta(
-                    idx,
-                    emitted_start,
-                    emitted_start + len(cols),
-                    contig0,
-                    np.asarray(positions, np.int64),
-                ),
-            )
+            yield flush()
         # A completed full pass has counted every record — cache it so a
         # later .n_variants doesn't re-parse the whole file.
         self._n_variants = seen

@@ -13,11 +13,10 @@ from __future__ import annotations
 import dataclasses
 from dataclasses import dataclass
 
-import jax
 import numpy as np
 
 from spark_examples_tpu.core.config import JobConfig
-from spark_examples_tpu.core.profiling import PhaseTimer
+from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.models.pca import fit_pca
 from spark_examples_tpu.models.pcoa import fit_pcoa
 from spark_examples_tpu.ops.eigh import eigh_flops
@@ -86,7 +85,7 @@ def pcoa_job(
     else:
         method = _eigh_method(job.compute.eigh_mode, n)
         with timer.phase("eigh"):
-            res = jax.block_until_ready(
+            res = hard_sync(
                 fit_pcoa(dist.astype(np.float32), k=k, method=method)
             )
         coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
@@ -110,7 +109,7 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
             vals = np.zeros(k)
     else:
         with sim.timer.phase("eigh"):
-            res = jax.block_until_ready(
+            res = hard_sync(
                 fit_pca(sim.similarity.astype(np.float32), k=k)
             )
         coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)

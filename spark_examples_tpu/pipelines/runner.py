@@ -20,13 +20,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-import jax
 import numpy as np
 
 from spark_examples_tpu.core import checkpoint as ckpt
 from spark_examples_tpu.core import meshes
 from spark_examples_tpu.core.config import IngestConfig, JobConfig
-from spark_examples_tpu.core.profiling import PhaseTimer
+from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.ingest import (
     ArraySource,
     SyntheticSource,
@@ -102,11 +101,15 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
     if acc is None:
         acc = gram_sharded.init_sharded(plan, n, metric)
 
+    # Variant-sharded placement needs the variant axis divisible by the
+    # mesh size; padding with MISSING is semantically free.
+    n_shards = mesh.devices.size if plan.mode == "variant" else 1
     blocks_done = 0
     last_stop = start_variant
     with timer.phase("gram"):
         for block, meta in stream_to_device(
-            source, bv, start_variant, sharding=plan.block_sharding
+            source, bv, start_variant, sharding=plan.block_sharding,
+            pad_multiple=n_shards,
         ):
             acc = update(acc, block)
             timer.add("gram_flops", gram.flops_per_block(n, block.shape[1], metric))
@@ -118,15 +121,15 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
                 and cfg.checkpoint_every_blocks
                 and blocks_done % cfg.checkpoint_every_blocks == 0
             ):
-                jax.block_until_ready(acc)
+                hard_sync(acc)
                 ckpt.save(
                     cfg.checkpoint_dir, acc, meta.stop, metric, bv,
                     source.sample_ids,
                 )
-        acc = jax.block_until_ready(acc)
+        acc = hard_sync(acc)
 
     with timer.phase("finalize"):
-        out = jax.block_until_ready(distances.finalize(acc, metric))
+        out = hard_sync(distances.finalize(acc, metric))
     # The stream already counted the variants (meta.stop of the final
     # block) — avoid source.n_variants, which for VCF may re-parse the file.
     n_variants = last_stop if last_stop > 0 else source.n_variants
@@ -157,7 +160,7 @@ def _run_braycurtis(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResu
             d = oracle.cpu_braycurtis(x)
     else:
         with timer.phase("distance"):
-            d = np.asarray(jax.block_until_ready(distances.braycurtis(x)))
+            d = np.asarray(distances.braycurtis(x))
     return SimilarityResult(
         similarity=1.0 - d,
         distance=d,

@@ -62,6 +62,15 @@ def test_cli_search_variants(capsys):
     assert "af=" in lines[0]
 
 
+def test_cli_search_variants_output_path(tmp_path, capsys):
+    out = str(tmp_path / "hist.tsv")
+    _run(capsys, "search-variants", "--n-samples", "12", "--n-variants",
+         "120", "--block-variants", "64", "--output-path", out)
+    rows = open(out).read().strip().splitlines()
+    assert rows[0].startswith("contig\tposition")
+    assert len(rows) == 121  # full table, not the 50-row console preview
+
+
 def test_cli_vcf_source(tmp_path, capsys):
     from spark_examples_tpu.ingest import write_vcf
 

@@ -79,6 +79,50 @@ def test_vcf_gz_and_region_filter(tmp_path, genotypes):
     np.testing.assert_array_equal(out, genotypes[:, : v // 2])
 
 
+def test_vcf_blocks_never_span_contigs(tmp_path, rng):
+    """A block straddling a contig boundary would mislabel variants."""
+    g1 = random_genotypes(rng, 6, 10, 0.0)
+    g2 = random_genotypes(rng, 6, 10, 0.0)
+    p1, p2 = str(tmp_path / "a.vcf"), str(tmp_path / "b.vcf")
+    write_vcf(p1, g1, contig="chr1", start_pos=100)
+    write_vcf(p2, g2, contig="chr2", start_pos=100)
+    # concatenate records into one multi-contig VCF
+    lines1 = [l for l in open(p1) if not l.startswith("#")]
+    lines2 = [l for l in open(p2) if not l.startswith("#")]
+    header = [l for l in open(p1) if l.startswith("#")]
+    multi = str(tmp_path / "multi.vcf")
+    open(multi, "w").writelines(header + lines1 + lines2)
+
+    src = VcfSource(multi)
+    blocks = list(src.blocks(8))  # 8 does not divide 10: blocks would span
+    # boundary flush: block starts/stops partition [0,20) without mixing
+    contigs = [m.contig for _b, m in blocks]
+    assert contigs == ["chr1", "chr1", "chr2", "chr2"]
+    spans = [(m.start, m.stop) for _b, m in blocks]
+    assert spans == [(0, 8), (8, 10), (10, 18), (18, 20)]
+    out = np.concatenate([b for b, _ in blocks], axis=1)
+    np.testing.assert_array_equal(out, np.concatenate([g1, g2], axis=1))
+    # record-ordinal resume from an unaligned cursor
+    resumed = list(src.blocks(8, start_variant=10))
+    assert [m.start for _b, m in resumed] == [10, 18]
+
+
+def test_checkpoint_survives_crash_window(tmp_path):
+    """If the new checkpoint never lands, the .old one must load."""
+    import os, shutil
+
+    from spark_examples_tpu.core import checkpoint as ckpt
+
+    ids = [f"s{i}" for i in range(4)]
+    path = str(tmp_path / "c")
+    ckpt.save(path, {"m": np.ones((4, 4))}, 64, "ibs", 64, ids)
+    # simulate the crash window: old moved aside, new never landed
+    os.replace(path, path + ".old")
+    acc, cursor = ckpt.load(path, "ibs", ids, block_variants=64)
+    assert cursor == 64
+    np.testing.assert_array_equal(np.asarray(acc["m"]), np.ones((4, 4)))
+
+
 @pytest.mark.parametrize(
     "gt,want",
     [("0/0", 0), ("0|1", 1), ("1/1", 2), ("./.", -1), (".", -1),
