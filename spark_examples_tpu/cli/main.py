@@ -54,6 +54,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "replicated", "variant", "tile2d"])
     c.add_argument("--eigh-mode", default="auto",
                    choices=["auto", "dense", "randomized"])
+    c.add_argument("--braycurtis-method", default="exact",
+                   choices=["exact", "matmul"],
+                   help="braycurtis lowering: elementwise VPU path or "
+                   "threshold-decomposed MXU matmuls (quantised)")
+    c.add_argument("--braycurtis-levels", type=int, default=256)
     c.add_argument("--checkpoint-dir", default=None)
     c.add_argument("--checkpoint-every-blocks", type=int, default=0)
     p.add_argument("--output-path", default=None)
@@ -84,6 +89,8 @@ def _job_from_args(args) -> JobConfig:
             mesh_shape=mesh_shape,
             gram_mode=args.gram_mode,
             eigh_mode=args.eigh_mode,
+            braycurtis_method=args.braycurtis_method,
+            braycurtis_levels=args.braycurtis_levels,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every_blocks=args.checkpoint_every_blocks,
         ),
@@ -119,7 +126,25 @@ def main(argv: list[str] | None = None) -> int:
     _add_common(p_sv)
     p_sv.add_argument("--positions", nargs="*", type=int, default=None)
 
+    p_cov = sub.add_parser("coverage",
+                           help="per-base read coverage over ranges "
+                           "(the SearchReads example tier)")
+    p_cov.add_argument("--references", nargs="*", default=[],
+                       metavar="CONTIG:START:END")
+    p_cov.add_argument("--reads-source", default="synthetic",
+                       choices=["synthetic", "sam"])
+    p_cov.add_argument("--path", default=None, help="SAM file path")
+    p_cov.add_argument("--reads-per-range", type=int, default=100_000)
+    p_cov.add_argument("--read-length", type=int, default=150)
+    p_cov.add_argument("--seed", type=int, default=0)
+    p_cov.add_argument("--output-path", default=None,
+                       help="write per-base depth TSV")
+
     args = parser.parse_args(argv)
+
+    if args.command == "coverage":
+        return _run_coverage(args)
+
     job = _job_from_args(args)
 
     # Imports deferred so --help stays instant (no jax/TPU init).
@@ -193,6 +218,44 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.timings:
         print(json.dumps(timer.report(), sort_keys=True), file=sys.stderr)
+    return 0
+
+
+def _run_coverage(args) -> int:
+    from spark_examples_tpu.ingest.reads import SamSource, SyntheticReadsSource
+    from spark_examples_tpu.pipelines.coverage import coverage
+
+    refs = [ReferenceRange.parse(r) for r in args.references]
+    if args.reads_source == "sam":
+        if not args.path:
+            raise SystemExit("coverage --reads-source sam requires --path")
+        src = SamSource(args.path, references=refs)
+    else:
+        if not refs:
+            refs = [ReferenceRange("chr22", 16_050_000, 16_150_000)]
+        src = SyntheticReadsSource(
+            references=refs,
+            reads_per_range=args.reads_per_range,
+            read_length=args.read_length,
+            seed=args.seed,
+        )
+    results = coverage(src)
+    for r in results:
+        h = [int(v) for v in r.histogram(10)]
+        print(
+            f"{r.reference}\treads={r.n_reads}\tmean_depth={r.mean:.2f}\t"
+            f"depth_hist[0..10+]={h}"
+        )
+    if args.output_path:
+        with open(args.output_path, "w") as f:
+            f.write("contig\tposition\tdepth\n")
+            for r in results:
+                for i, d in enumerate(r.depth):
+                    f.write(
+                        f"{r.reference.contig}\t{r.reference.start + i}\t"
+                        f"{int(d)}\n"
+                    )
+        print(f"depth table -> {args.output_path}")
     return 0
 
 

@@ -31,12 +31,17 @@ class ReferenceRange:
     def parse(cls, spec: str) -> "ReferenceRange":
         try:
             contig, start, end = spec.split(":")
-            return cls(contig, int(start), int(end))
+            rng = cls(contig, int(start), int(end))
         except ValueError:
             raise ValueError(
                 f"bad reference range {spec!r}: expected CONTIG:START:END "
                 "(e.g. chr22:16050000:17000000)"
             ) from None
+        if rng.end <= rng.start:
+            raise ValueError(
+                f"bad reference range {spec!r}: end must be > start"
+            )
+        return rng
 
     def __str__(self) -> str:
         return f"{self.contig}:{self.start}:{self.end}"
@@ -66,6 +71,10 @@ class ComputeConfig:
     # level only — it dispatches to the dense-table distances.braycurtis
     # path, not the gram accumulator.
     metric: str = "ibs"
+    # braycurtis lowering: "exact" (VPU elementwise) or "matmul"
+    # (threshold-decomposed MXU path, quantised to `braycurtis_levels`).
+    braycurtis_method: str = "exact"
+    braycurtis_levels: int = 256
     num_pc: int = 10
     mesh_shape: tuple[int, int] | None = None  # None -> auto-factor devices
     gram_mode: str = "auto"  # auto | replicated | variant | tile2d

@@ -102,8 +102,75 @@ def braycurtis(
 
     BC_ij = sum_f |x_i - x_j| / sum_f (x_i + x_j), the metric of benchmark
     config 3 (10k-sample OTU table, BASELINE.md). Zero-total pairs get 0.
+    Exact, VPU-bound; for large N use :func:`braycurtis_matmul`.
     """
     num = pairwise_manhattan(x, row_tile=row_tile, feat_tile=feat_tile)
-    totals = x.astype(jnp.float32).sum(axis=1)
+    return bc_from_manhattan(num, jnp.asarray(x, jnp.float32).sum(axis=1))
+
+
+def bc_from_manhattan(num: jnp.ndarray, totals: jnp.ndarray) -> jnp.ndarray:
+    """Shared Bray-Curtis finalization: Manhattan numerator + row totals
+    -> BC matrix. Pins the zero-total-pair -> 0 convention once for every
+    lowering (exact VPU, MXU threshold, Pallas)."""
     den = totals[:, None] + totals[None, :]
     return jnp.where(den > 0, num / den, 0.0)
+
+
+@partial(jax.jit, static_argnames=("levels", "precise"))
+def braycurtis_matmul(
+    x: jnp.ndarray, levels: int = 256, precise: bool = False
+) -> jnp.ndarray:
+    """Bray-Curtis via threshold-decomposed MXU matmuls (TPU-first path).
+
+    The min-sum is not bilinear, but its threshold decomposition is:
+
+        min(a, b) = sum_t  w_t * [a >= v_t] * [b >= v_t]
+
+    Per-feature normalisation to [0, 1] puts every feature on a shared
+    ``levels``-point grid; the per-feature scale folds symmetrically into
+    the indicators as sqrt(scale/levels), so
+
+        sum_f min = sum_t A_t A_t^T,   A_t = [x_n >= (t+.5)/L] * sqrt(w)
+
+    — ``levels`` (N, F) matmuls that tile onto the MXU at full rate,
+    replacing a VPU-bound elementwise pass ~50-100x slower at scale.
+    Then BC = (den - 2*minsum) / den with den = totals_i + totals_j.
+
+    Accuracy: quantisation error per feature is at most scale_f / (2L)
+    (exact when each feature takes <= L distinct evenly spaced values,
+    e.g. integer counts with max < L), plus ~0.4% relative bf16 rounding
+    on the folded weights (``precise=True`` runs f32 matmuls at half MXU
+    rate to remove the latter).
+    """
+    if levels < 1:
+        raise ValueError(f"braycurtis levels must be >= 1, got {levels}")
+    dt = jnp.float32 if precise else jnp.bfloat16
+    x = jnp.maximum(x, 0).astype(jnp.float32)
+    n, f = x.shape
+    scale = x.max(axis=0)
+    xn = jnp.where(scale > 0, x / jnp.maximum(scale, 1e-30), 0.0)
+    sw = jnp.sqrt(scale / levels).astype(dt)
+
+    # Batch CHUNK thresholds into one matmul: K = F * CHUNK keeps the MXU
+    # fed with fat contractions instead of `levels` skinny ones. The grid
+    # is padded to a chunk multiple with sentinel thresholds > 1 whose
+    # indicators are identically zero, so a ragged tail contributes 0.
+    chunk = max(1, min(8, levels))
+    n_iters = -(-levels // chunk)
+    thr_grid = (jnp.arange(n_iters * chunk, dtype=jnp.float32) + 0.5) / levels
+    thr_grid = jnp.where(thr_grid < 1.0, thr_grid, 2.0)
+
+    def body(c, acc):
+        thr = jax.lax.dynamic_slice(thr_grid, (c * chunk,), (chunk,))
+        # (N, F, CHUNK) indicators, folded weights, flattened to (N, F*CHUNK)
+        a = (xn[:, :, None] >= thr[None, None, :]).astype(dt)
+        a = (a * sw[None, :, None]).reshape(n, f * chunk)
+        return acc + jax.lax.dot_general(
+            a, a, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    minsum = jax.lax.fori_loop(0, n_iters, body, jnp.zeros((n, n), jnp.float32))
+    totals = x.sum(axis=1)
+    den = totals[:, None] + totals[None, :]
+    num = jnp.maximum(den - 2.0 * minsum, 0.0)
+    return bc_from_manhattan(num, totals)

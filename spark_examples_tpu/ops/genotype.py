@@ -76,41 +76,41 @@ def gram_pieces(block: jnp.ndarray, accum_dtype=jnp.float32) -> dict[str, jnp.nd
       ``s``   — shared-alt counts            T1 T1^T
       ``d1``  — Manhattan (sum |a-b|)        A + A^T - 2 P
       ``ibs2``— exact-match counts           sum_g X_g X_g^T
-      ``dot`` — dosage inner products        y y^T (y = masked dosage)
+      ``dot`` — dosage inner products        Y Y^T (Y = masked dosage)
       ``e2``  — squared euclidean over valid pairs
 
-    Each product is a separate ``dot_general`` so that, under ``jit``,
-    products feeding only unselected pieces are dead-code-eliminated —
-    the IBS metric, for instance, compiles to exactly the 4 matmuls it
-    needs (C C^T, T1 C^T, T2 C^T fused-stack, T1 T1^T, T2 T2^T), not all
-    six unique indicator products.
+    Dots are taken against *derived operands* where that saves MXU work:
+    Y = T1 + T2 (masked dosage) and Q = T1 + 3 T2 (masked squared dosage)
+    fold what would be two or three indicator products into one matmul —
+    e.g. sum of dosages over valid pairs is one Y C^T dot, and the
+    squared-euclidean piece is Q C^T + C Q^T - 2 Y Y^T, two dots total.
+    Every product is a separate ``dot_general`` so that, under ``jit``,
+    products feeding only unselected pieces are dead-code-eliminated:
+    IBS compiles to exactly 4 matmuls (C C^T, Y C^T, T1 T1^T, T2 T2^T),
+    euclidean to 2, the dosage Gram to 1.
 
     Each piece is additive across variant blocks, so the streaming driver
     just FMAs them into resident accumulators.
     """
     c, t1, t2 = thresholds(block)
+    y = t1 + t2  # masked dosage: {0, 1, 2}, missing -> 0
+    q = t1 + 3.0 * t2  # masked squared dosage: {0, 1, 4}
+
     cc = _xxt(c, c, accum_dtype)
+    yc = _xxt(y, c, accum_dtype)
+    qc = _xxt(q, c, accum_dtype)
+    yy = _xxt(y, y, accum_dtype)
     t1c = _xxt(t1, c, accum_dtype)
-    t2c = _xxt(t2, c, accum_dtype)
     t1t1 = _xxt(t1, t1, accum_dtype)
     t1t2 = _xxt(t1, t2, accum_dtype)
     t2t2 = _xxt(t2, t2, accum_dtype)
-    ct1, ct2, t2t1 = t1c.T, t2c.T, t1t2.T
 
-    a = t1c + t2c  # A = (T1 + T2) C^T ; sum of dosage a over valid pairs
-    p = t1t1 + t2t2  # sum of min(a, b)
-    d1 = a + a.T - 2.0 * p
+    p = t1t1 + t2t2  # sum of min(a, b) over valid pairs
+    d1 = yc + yc.T - 2.0 * p
     # IBS2 = sum over one-hot states; expand (C-T1)(C-T1)^T + (T1-T2)(T1-T2)^T
-    # + T2 T2^T in terms of the nine products.
+    # + T2 T2^T in indicator products.
     ibs2 = (
-        cc - ct1 - t1c + t1t1  # X0 X0^T
-        + t1t1 - t1t2 - t2t1 + t2t2  # X1 X1^T
-        + t2t2  # X2 X2^T
+        cc - t1c.T - t1c + 2.0 * t1t1 - t1t2 - t1t2.T + 2.0 * t2t2
     )
-    # dosage dot product y y^T with y = T1 + T2:
-    dot = t1t1 + t1t2 + t2t1 + t2t2
-    # squared-euclidean over valid pairs: sum c_i c_j (a - b)^2
-    #   = Q C^T + C Q^T - 2 y y^T  with Q = d^2 masked = T1 + 3 T2
-    q = (t1c + 3.0 * t2c)
-    e2 = q + q.T - 2.0 * dot
-    return {"m": cc, "s": t1t1, "d1": d1, "ibs2": ibs2, "dot": dot, "e2": e2}
+    e2 = qc + qc.T - 2.0 * yy
+    return {"m": cc, "s": t1t1, "d1": d1, "ibs2": ibs2, "dot": yy, "e2": e2}

@@ -45,10 +45,10 @@ PIECES_FOR_METRIC: dict[str, tuple[str, ...]] = {
 
 GRAM_METRICS = tuple(PIECES_FOR_METRIC) + ("grm",)
 
-# Unique indicator matmuls each metric's selected pieces actually execute
-# after dead-code elimination (see gram_pieces): used for honest GFLOPS.
-_N_PRODUCTS = {"ibs": 5, "ibs2": 5, "shared-alt": 1, "euclidean": 5,
-               "dot": 3, "grm": 1}
+# Unique matmuls each metric's selected pieces actually execute after
+# dead-code elimination (see gram_pieces): used for honest GFLOPS.
+_N_PRODUCTS = {"ibs": 4, "ibs2": 5, "shared-alt": 1, "euclidean": 2,
+               "dot": 1, "grm": 1}
 
 
 def flops_per_block(n: int, v: int, metric: str) -> float:

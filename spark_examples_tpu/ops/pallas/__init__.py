@@ -1,0 +1,1 @@
+from spark_examples_tpu.ops.pallas import braycurtis_kernel  # noqa: F401

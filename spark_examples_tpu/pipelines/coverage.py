@@ -1,0 +1,68 @@
+"""Coverage pipeline — the ``SearchReadsExample*`` tier (SURVEY.md §3.4).
+
+The reference's read examples computed per-base coverage / read counts
+over BAM regions via the API. The TPU-native form: read (start, length)
+batches become difference-array scatter-adds (+1 at start, -1 past end)
+on device, and per-base depth is one inclusive ``cumsum`` scan — both
+XLA-native, no per-read host loop. Depth histograms and mean coverage
+come off the same array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_examples_tpu.core.config import ReferenceRange
+from spark_examples_tpu.ingest.reads import ReadsSource
+
+
+@partial(jax.jit, static_argnames=("span",))
+def _diff_accumulate(diff, starts, lengths, offset, span):
+    """Scatter +1/-1 read boundaries into the difference array."""
+    s = jnp.clip(starts - offset, 0, span - 1)
+    e = jnp.clip(starts + lengths - offset, 0, span)  # exclusive end
+    diff = diff.at[s].add(1.0)
+    diff = diff.at[e].add(-1.0)  # index `span` lands in the sentinel slot
+    return diff
+
+
+@jax.jit
+def _depth_from_diff(diff):
+    return jnp.cumsum(diff[:-1])
+
+
+@dataclass
+class CoverageResult:
+    reference: ReferenceRange
+    depth: np.ndarray  # per-base coverage, len = range span
+    n_reads: int
+
+    @property
+    def mean(self) -> float:
+        return float(self.depth.mean()) if self.depth.size else 0.0
+
+    def histogram(self, max_depth: int = 100) -> np.ndarray:
+        return np.bincount(
+            np.minimum(self.depth.astype(np.int64), max_depth),
+            minlength=max_depth + 1,
+        )
+
+
+def coverage(source: ReadsSource, batch: int = 262144) -> list[CoverageResult]:
+    """Per-base coverage for every range of the source."""
+    out = []
+    for ref in source.ranges():
+        span = ref.end - ref.start
+        diff = jnp.zeros(span + 1, jnp.float32)  # +1 sentinel for ends
+        n_reads = 0
+        for starts, lengths in source.read_batches(ref, batch):
+            diff = _diff_accumulate(diff, starts, lengths, ref.start, span)
+            n_reads += len(starts)
+        depth = np.asarray(_depth_from_diff(diff))
+        out.append(CoverageResult(ref, depth, n_reads))
+    return out

@@ -158,6 +158,13 @@ def _run_braycurtis(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResu
     if job.compute.backend == "cpu-reference":
         with timer.phase("distance"):
             d = oracle.cpu_braycurtis(x)
+    elif job.compute.braycurtis_method == "matmul":
+        with timer.phase("distance"):
+            d = np.asarray(
+                distances.braycurtis_matmul(
+                    x, levels=job.compute.braycurtis_levels
+                )
+            )
     else:
         with timer.phase("distance"):
             d = np.asarray(distances.braycurtis(x))

@@ -107,24 +107,24 @@ def pca_mllib_route(similarity: np.ndarray, k: int = 10):
 
 # --------------------------------------------------------- cpu backend
 
-# Indicator products each gram piece needs (mirrors the DCE the jitted
-# TPU path gets for free) — keeps the measured CPU baseline honest by not
-# doing matmuls the metric never uses.
+# Products each gram piece needs, using the same derived operands as the
+# TPU path (y = t1 + t2, q = t1 + 3 t2) — mirrors the DCE the jitted path
+# gets for free, keeping the measured CPU baseline honest.
 _PIECE_PRODUCTS = {
     "m": ("cc",),
     "s": ("t1t1",),
-    "d1": ("t1c", "t2c", "t1t1", "t2t2"),
+    "d1": ("yc", "t1t1", "t2t2"),
     "ibs2": ("cc", "t1c", "t1t1", "t1t2", "t2t2"),
-    "dot": ("t1t1", "t1t2", "t2t2"),
-    "e2": ("t1c", "t2c", "t1t1", "t1t2", "t2t2"),
+    "dot": ("yy",),
+    "e2": ("qc", "yy"),
 }
 
 
 def cpu_gram_pieces(genotypes: np.ndarray, pieces: tuple[str, ...] | None = None):
     """Vectorized NumPy mirror of ops.genotype.gram_pieces (f64).
 
-    ``pieces`` restricts both the outputs and the underlying indicator
-    matmuls to what the requested statistics need.
+    ``pieces`` restricts both the outputs and the underlying matmuls to
+    what the requested statistics need.
     """
     if pieces is None:
         pieces = ("m", "s", "d1", "ibs2", "dot", "e2")
@@ -132,8 +132,11 @@ def cpu_gram_pieces(genotypes: np.ndarray, pieces: tuple[str, ...] | None = None
     c = (g >= 0).astype(np.float64)
     t1 = (g >= 1).astype(np.float64)
     t2 = (g >= 2).astype(np.float64)
-    ops = {"cc": (c, c), "t1c": (t1, c), "t2c": (t2, c),
-           "t1t1": (t1, t1), "t1t2": (t1, t2), "t2t2": (t2, t2)}
+    y = t1 + t2
+    q = t1 + 3.0 * t2
+    ops = {"cc": (c, c), "t1c": (t1, c), "yc": (y, c), "qc": (q, c),
+           "yy": (y, y), "t1t1": (t1, t1), "t1t2": (t1, t2),
+           "t2t2": (t2, t2)}
     needed = {p for piece in pieces for p in _PIECE_PRODUCTS[piece]}
     prod = {name: a @ b.T for name, (a, b) in ops.items() if name in needed}
 
@@ -144,9 +147,8 @@ def cpu_gram_pieces(genotypes: np.ndarray, pieces: tuple[str, ...] | None = None
         elif piece == "s":
             out["s"] = prod["t1t1"]
         elif piece == "d1":
-            a = prod["t1c"] + prod["t2c"]
             p = prod["t1t1"] + prod["t2t2"]
-            out["d1"] = a + a.T - 2.0 * p
+            out["d1"] = prod["yc"] + prod["yc"].T - 2.0 * p
         elif piece == "ibs2":
             out["ibs2"] = (
                 prod["cc"] - prod["t1c"] - prod["t1c"].T
@@ -154,13 +156,9 @@ def cpu_gram_pieces(genotypes: np.ndarray, pieces: tuple[str, ...] | None = None
                 + 2.0 * prod["t2t2"]
             )
         elif piece == "dot":
-            out["dot"] = (
-                prod["t1t1"] + prod["t1t2"] + prod["t1t2"].T + prod["t2t2"]
-            )
+            out["dot"] = prod["yy"]
         elif piece == "e2":
-            dot = prod["t1t1"] + prod["t1t2"] + prod["t1t2"].T + prod["t2t2"]
-            q = prod["t1c"] + 3.0 * prod["t2c"]
-            out["e2"] = q + q.T - 2.0 * dot
+            out["e2"] = prod["qc"] + prod["qc"].T - 2.0 * prod["yy"]
     return out
 
 

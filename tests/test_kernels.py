@@ -1,0 +1,74 @@
+"""Pallas kernel and MXU-path Bray-Curtis tests (CPU interpret mode)."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.ops.distances import braycurtis_matmul
+from spark_examples_tpu.ops.pallas.braycurtis_kernel import (
+    braycurtis_pallas,
+    pairwise_manhattan_pallas,
+)
+from spark_examples_tpu.utils import oracle
+
+
+@pytest.fixture
+def otu(rng):
+    # integer OTU-like counts: sparse, overdispersed
+    x = rng.gamma(0.5, 40.0, size=(70, 600)) * (rng.random((70, 600)) > 0.6)
+    return x.astype(np.int32).astype(np.float32)
+
+
+def test_pallas_manhattan_matches_numpy(otu):
+    got = np.asarray(pairwise_manhattan_pallas(otu, interpret=True))
+    want = np.abs(otu[:, None, :] - otu[None, :, :]).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-2)
+
+
+def test_pallas_braycurtis_matches_oracle(otu):
+    got = np.asarray(braycurtis_pallas(otu, interpret=True))
+    want = oracle.cpu_braycurtis(otu)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_braycurtis_matmul_quantization_bound(otu):
+    want = oracle.cpu_braycurtis(otu)
+    for levels, tol in [(64, 2e-2), (256, 6e-3)]:
+        got = np.asarray(braycurtis_matmul(otu, levels=levels))
+        err = np.abs(got - want).max()
+        assert err < tol, f"levels={levels}: err {err}"
+    # higher levels must not be less accurate (monotone refinement)
+    e64 = np.abs(np.asarray(braycurtis_matmul(otu, levels=64)) - want).max()
+    e512 = np.abs(np.asarray(braycurtis_matmul(otu, levels=512)) - want).max()
+    assert e512 <= e64
+
+
+def test_braycurtis_matmul_exact_for_binary():
+    """0/1 presence-absence data lies exactly on the threshold grid."""
+    rng = np.random.default_rng(4)
+    x = (rng.random((40, 300)) > 0.5).astype(np.float32)
+    got = np.asarray(braycurtis_matmul(x, levels=16, precise=True))
+    want = oracle.cpu_braycurtis(x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_braycurtis_matmul_pipeline_option(rng):
+    from spark_examples_tpu.core.config import (
+        ComputeConfig,
+        IngestConfig,
+        JobConfig,
+    )
+    from spark_examples_tpu.ingest import ArraySource
+    from spark_examples_tpu.pipelines import runner
+
+    x = np.abs(rng.integers(0, 3, (20, 256), dtype=np.int8))
+    res = runner.run_similarity(
+        JobConfig(
+            ingest=IngestConfig(block_variants=64),
+            compute=ComputeConfig(metric="braycurtis",
+                                  braycurtis_method="matmul",
+                                  braycurtis_levels=8),
+        ),
+        source=ArraySource(x.astype(np.int8)),
+    )
+    want = oracle.cpu_braycurtis(x.astype(np.float64))
+    np.testing.assert_allclose(res.distance, want, rtol=1e-2, atol=1e-3)

@@ -1,0 +1,73 @@
+import numpy as np
+
+from spark_examples_tpu.cli.main import main
+from spark_examples_tpu.core.config import ReferenceRange
+from spark_examples_tpu.ingest.reads import Read, SamSource, SyntheticReadsSource
+from spark_examples_tpu.pipelines.coverage import coverage
+
+
+def _naive_depth(reads, ref):
+    depth = np.zeros(ref.end - ref.start, np.int64)
+    for start, length in reads:
+        s = max(start, ref.start) - ref.start
+        e = min(start + length, ref.end) - ref.start
+        if e > s:
+            depth[s:e] += 1
+    return depth
+
+
+def test_coverage_matches_naive():
+    ref = ReferenceRange("chr1", 1000, 3000)
+    src = SyntheticReadsSource([ref], reads_per_range=500, read_length=100,
+                               seed=3)
+    got = coverage(src)[0]
+    reads = []
+    for starts, lengths in src.read_batches(ref):
+        reads += list(zip(starts, lengths))
+    want = _naive_depth(reads, ref)
+    np.testing.assert_array_equal(got.depth.astype(np.int64), want)
+    assert got.n_reads == 500
+    assert got.mean > 0
+    assert got.histogram(20).sum() == 2000
+
+
+def test_coverage_batching_invariant():
+    ref = ReferenceRange("chrX", 0, 5000)
+    src = SyntheticReadsSource([ref], reads_per_range=2000, seed=9)
+    a = coverage(src, batch=100)[0].depth
+    b = coverage(src, batch=100000)[0].depth
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sam_source(tmp_path):
+    ref = ReferenceRange("chr7", 0, 500)
+    sam = tmp_path / "toy.sam"
+    reads = [Read("r1", "chr7", 10, 50), Read("r2", "chr7", 40, 50),
+             Read("r3", "chr7", 480, 50), Read("r4", "chr8", 10, 50)]
+    with open(sam, "w") as f:
+        f.write("@HD\tVN:1.6\n@SQ\tSN:chr7\tLN:500\n@SQ\tSN:chr8\tLN:500\n")
+        for r in reads:
+            f.write(
+                f"{r.name}\t0\t{r.contig}\t{r.start + 1}\t60\t{r.length}M\t"
+                f"*\t0\t0\t{'A' * r.length}\t*\n"
+            )
+    src = SamSource(str(sam), references=[ref])
+    res = coverage(src)[0]
+    assert res.n_reads == 3  # chr8 read excluded
+    want = _naive_depth([(10, 50), (40, 50), (480, 50)], ref)
+    np.testing.assert_array_equal(res.depth.astype(np.int64), want)
+    # header-derived ranges
+    auto = SamSource(str(sam))
+    assert [r.contig for r in auto.ranges()] == ["chr7", "chr8"]
+
+
+def test_cli_coverage(tmp_path, capsys):
+    out = str(tmp_path / "depth.tsv")
+    rc = main(["coverage", "--references", "chr22:100:1100",
+               "--reads-per-range", "300", "--read-length", "50",
+               "--output-path", out])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "reads=300" in cap.out and "mean_depth=" in cap.out
+    rows = open(out).read().strip().splitlines()
+    assert len(rows) == 1001  # header + 1000 positions
