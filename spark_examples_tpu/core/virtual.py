@@ -1,0 +1,43 @@
+"""Virtual-CPU device provisioning — the `local[*]` analogue.
+
+The reference's only multi-node-without-a-cluster story was Spark's
+``local[*]`` master: the real partition/shuffle code paths running
+multi-threaded in one JVM (SURVEY.md §4). The JAX equivalent is the host
+platform with N forced virtual devices: the same mesh/sharding/collective
+code paths run multi-"device" in one process. Used by the test suite
+(``tests/conftest.py``) and the driver's multi-chip dry run
+(``__graft_entry__.dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Point JAX at the CPU platform with ``n_devices`` virtual devices.
+
+    Must run before the first computation touches a backend (backends
+    initialise lazily, so an already-imported jax is fine). Both steps are
+    required in this environment: the ambient profile pins
+    ``JAX_PLATFORMS=axon`` (the real TPU) and a ``sitecustomize.py``
+    imports jax at interpreter startup, so the env var alone is captured
+    too late — the ``jax.config`` update is what actually wins. A
+    pre-existing ``xla_force_host_platform_device_count`` flag is
+    overridden, not kept.
+    """
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -6,22 +6,9 @@ one process, so distributed logic is exercised without TPU hardware.
 Must run before the first `import jax` anywhere in the test session.
 """
 
-import os
+from spark_examples_tpu.core.virtual import force_virtual_cpu
 
-# Hard override: the ambient environment pins JAX_PLATFORMS=axon (the
-# real TPU) and a sitecustomize.py imports jax at interpreter startup,
-# so the env var alone is captured too late — update jax's config too
-# (backends initialise lazily, so this still wins if nothing computed).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
