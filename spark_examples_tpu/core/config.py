@@ -76,6 +76,13 @@ class ComputeConfig:
     braycurtis_method: str = "exact"
     braycurtis_levels: int = 256
     num_pc: int = 10
+    # Host->device block transport: "packed" ships 2-bit packed blocks
+    # (4 dosages/byte, unpacked on device — ingest/bitpack.py); "dense"
+    # ships int8. "auto" packs the metrics whose inputs are dosages by
+    # definition (ibs/ibs2/shared-alt/grm) and keeps dot/euclidean dense,
+    # since those may be fed arbitrary int8 tables the 2-bit codec would
+    # reject. Packed is exact for dosages {-1,0,1,2}.
+    pack_stream: str = "auto"  # auto | packed | dense
     mesh_shape: tuple[int, int] | None = None  # None -> auto-factor devices
     gram_mode: str = "auto"  # auto | replicated | variant | tile2d
     eigh_mode: str = "auto"  # auto | dense | randomized

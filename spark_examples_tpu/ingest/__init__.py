@@ -1,5 +1,16 @@
-from spark_examples_tpu.ingest import packed, prefetch, source, synthetic, vcf  # noqa: F401
-from spark_examples_tpu.ingest.packed import load_packed, save_packed  # noqa: F401
+from spark_examples_tpu.ingest import (  # noqa: F401
+    bitpack,
+    packed,
+    prefetch,
+    source,
+    synthetic,
+    vcf,
+)
+from spark_examples_tpu.ingest.packed import (  # noqa: F401
+    Packed2BitSource,
+    load_packed,
+    save_packed,
+)
 from spark_examples_tpu.ingest.source import (  # noqa: F401
     ArraySource,
     BlockMeta,

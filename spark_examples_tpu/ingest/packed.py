@@ -4,19 +4,30 @@ The Stanford fork added a BigQuery → RDD ingestion path for
 1000-Genomes-style variant tables (SURVEY.md §2.1 "BigQuery ingestion
 path"). Its spirit — bulk columnar export consumed by the compute tier,
 bypassing the paged API — maps here to a directory holding a memmappable
-``genotypes.npy`` (N, V) int8 matrix plus a JSON sidecar of sample ids /
-positions. Reading is zero-copy block slicing of the memmap.
+genotype matrix plus a JSON sidecar of sample ids / positions. Reading is
+zero-copy block slicing of the memmap.
+
+Two on-disk layouts:
+
+- ``bits=8`` (legacy): ``genotypes.npy``, (N, V) int8 dosages.
+- ``bits=2`` (default): ``genotypes.2bit.npy``, (N, ceil(V/4)) uint8 —
+  four dosages per byte (ingest/bitpack.py). Quarter the disk footprint
+  and, crucially, quarter the host→device traffic: the streaming layer
+  slices these bytes zero-copy (``packed_blocks``) and the gram update
+  unpacks on device.
 """
 
 from __future__ import annotations
 
 import json
 import os
+from dataclasses import dataclass
 
 import numpy as np
 
 from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE
-from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.ingest import bitpack
+from spark_examples_tpu.ingest.source import ArraySource, BlockMeta
 
 
 def save_packed(
@@ -25,13 +36,21 @@ def save_packed(
     sample_ids: list[str] | None = None,
     contig: str | None = None,
     positions: np.ndarray | None = None,
+    bits: int = 2,
 ) -> None:
+    if bits not in (2, 8):
+        raise ValueError(f"bits must be 2 or 8, got {bits}")
     os.makedirs(path, exist_ok=True)
-    np.save(os.path.join(path, "genotypes.npy"),
-            np.ascontiguousarray(genotypes, dtype=GENOTYPE_DTYPE))
+    if bits == 2:
+        np.save(os.path.join(path, "genotypes.2bit.npy"),
+                bitpack.pack_dosages(np.asarray(genotypes)))
+    else:
+        np.save(os.path.join(path, "genotypes.npy"),
+                np.ascontiguousarray(genotypes, dtype=GENOTYPE_DTYPE))
     meta = {
         "n_samples": int(genotypes.shape[0]),
         "n_variants": int(genotypes.shape[1]),
+        "bits": bits,
         "sample_ids": sample_ids,
         "contig": contig,
     }
@@ -42,15 +61,94 @@ def save_packed(
                 np.asarray(positions, np.int64))
 
 
-def load_packed(path: str, mmap: bool = True) -> ArraySource:
-    g = np.load(os.path.join(path, "genotypes.npy"),
-                mmap_mode="r" if mmap else None)
+@dataclass
+class Packed2BitSource:
+    """2-bit columnar store as a GenotypeSource.
+
+    ``blocks()`` unpacks host-side (protocol compatibility, CPU oracle
+    path); ``packed_blocks()`` yields zero-copy byte slices for the
+    packed streaming path (ingest/prefetch.stream_to_device(pack=True)).
+    """
+
+    packed: np.ndarray  # (N, ceil(V/4)) uint8, possibly memmapped
+    v: int  # true variant count (last byte may hold pad codes)
+    ids: list[str] | None = None
+    contig: str | None = None
+    positions: np.ndarray | None = None
+
+    @property
+    def n_samples(self) -> int:
+        return int(self.packed.shape[0])
+
+    @property
+    def n_variants(self) -> int:
+        return self.v
+
+    @property
+    def sample_ids(self) -> list[str]:
+        if self.ids is not None:
+            return self.ids
+        return [f"S{i:06d}" for i in range(self.n_samples)]
+
+    def packed_blocks(self, block_variants: int, start_variant: int = 0):
+        """Yield ((N, <=block_variants/4) uint8, meta) zero-copy byte
+        slices. Requires ``block_variants`` divisible by 4 so blocks fall
+        on byte boundaries (``blocks()`` has no such restriction)."""
+        if block_variants % bitpack.VARIANTS_PER_BYTE:
+            raise ValueError(
+                f"packed_blocks needs block_variants divisible by "
+                f"{bitpack.VARIANTS_PER_BYTE}, got {block_variants}"
+            )
+        bw = block_variants // bitpack.VARIANTS_PER_BYTE
+        total_w = self.packed.shape[1]
+        first = -(-start_variant // block_variants)
+        for idx in range(first, -(-self.v // block_variants)):
+            lo_b, hi_b = idx * bw, min((idx + 1) * bw, total_w)
+            block = np.ascontiguousarray(self.packed[:, lo_b:hi_b])
+            lo, hi = idx * block_variants, min(
+                (idx + 1) * block_variants, self.v
+            )
+            pos = None
+            if self.positions is not None:
+                pos = self.positions[lo:hi]
+            yield block, BlockMeta(idx, lo, hi, self.contig, pos)
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        """Dense int8 blocks of any width: unpack the covering byte range
+        and slice off the sub-byte offset."""
+        vpb = bitpack.VARIANTS_PER_BYTE
+        first = -(-start_variant // block_variants)
+        for idx in range(first, -(-self.v // block_variants)):
+            lo = idx * block_variants
+            hi = min(lo + block_variants, self.v)
+            dense = bitpack.unpack_dosages_np(
+                self.packed[:, lo // vpb : -(-hi // vpb)]
+            )
+            block = dense[:, lo % vpb : lo % vpb + (hi - lo)]
+            pos = None
+            if self.positions is not None:
+                pos = self.positions[lo:hi]
+            yield block, BlockMeta(idx, lo, hi, self.contig, pos)
+
+
+def load_packed(path: str, mmap: bool = True):
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     positions = None
     pos_path = os.path.join(path, "positions.npy")
     if os.path.exists(pos_path):
         positions = np.load(pos_path)
+    mode = "r" if mmap else None
+    if meta.get("bits", 8) == 2:
+        p = np.load(os.path.join(path, "genotypes.2bit.npy"), mmap_mode=mode)
+        return Packed2BitSource(
+            packed=p,
+            v=meta["n_variants"],
+            ids=meta.get("sample_ids"),
+            contig=meta.get("contig"),
+            positions=positions,
+        )
+    g = np.load(os.path.join(path, "genotypes.npy"), mmap_mode=mode)
     return ArraySource(
         genotypes=g,
         ids=meta.get("sample_ids"),

@@ -94,6 +94,58 @@ def update(acc: dict, block: jnp.ndarray, metric: str) -> dict:
     return _update(acc, block, PIECES_FOR_METRIC[metric])
 
 
+def _update_packed_impl(acc, packed, pieces: tuple[str, ...]):
+    """Same contribution from a 2-bit packed (N, v_blk/4) uint8 block.
+
+    The shift/mask unpack (ingest/bitpack.py) fuses into the indicator
+    thresholds under jit; shipping packed blocks quarters host→device
+    traffic — the binding constraint at the 40M-variant north star.
+    """
+    from spark_examples_tpu.ingest.bitpack import unpack_dosages
+
+    return _update_impl(acc, unpack_dosages(packed), pieces)
+
+
+def _update_grm_packed_impl(acc: dict, packed) -> dict:
+    from spark_examples_tpu.ingest.bitpack import unpack_dosages
+
+    return _update_grm_impl(acc, unpack_dosages(packed))
+
+
+def impl_for(metric: str, packed: bool):
+    """The one dispatch point: unjitted ``(acc, block) -> acc`` for a
+    metric/transport pair, pieces already bound. Every jitted wrapper
+    (here and the sharded planner) derives from this."""
+    _check_metric(metric)
+    if metric == "grm":
+        return _update_grm_packed_impl if packed else _update_grm_impl
+    impl = _update_packed_impl if packed else _update_impl
+    return partial(impl, pieces=PIECES_FOR_METRIC[metric])
+
+
+_update_packed = partial(
+    jax.jit, static_argnames=("pieces",), donate_argnums=(0,)
+)(_update_packed_impl)
+
+update_grm_packed = partial(jax.jit, donate_argnums=(0,))(
+    _update_grm_packed_impl
+)
+
+
+def update_packed(acc: dict, packed: jnp.ndarray, metric: str) -> dict:
+    """Packed-block twin of :func:`update`."""
+    _check_metric(metric)
+    if metric == "grm":
+        return update_grm_packed(acc, packed)
+    return _update_packed(acc, packed, PIECES_FOR_METRIC[metric])
+
+
+# Metrics whose inputs are genotype dosages *by definition* — safe to ship
+# 2-bit packed under pack_stream="auto". dot/euclidean accept arbitrary
+# int8 tables, so auto keeps them on the dense transport.
+DOSAGE_METRICS = ("ibs", "ibs2", "shared-alt", "grm")
+
+
 def _update_grm_impl(acc: dict, block: jnp.ndarray) -> dict:
     """VanRaden-form GRM accumulation with in-block allele frequencies."""
     valid = (block >= 0)

@@ -25,7 +25,7 @@ Mode choice is automatic from accumulator-memory footprint unless forced.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import numpy as np
@@ -94,40 +94,53 @@ def init_sharded(plan: GramPlan, n: int, metric: str):
     return {k: jax.device_put(v, shardings[k]) for k, v in acc.items()}
 
 
-def make_update(plan: GramPlan, metric: str):
+@lru_cache(maxsize=64)
+def _jitted_update(plan: GramPlan, metric: str, packed: bool):
+    """One jit wrapper per (plan, metric, packed) — re-entering the same
+    job shape reuses the compiled executable instead of re-tracing (a
+    fresh ``jax.jit`` object owns a fresh compilation cache)."""
+    acc_sh = _acc_shardings(plan, metric)
+    return jax.jit(
+        gram_ops.impl_for(metric, packed),
+        in_shardings=(acc_sh, plan.block_sharding),
+        out_shardings=acc_sh,
+        donate_argnums=(0,),
+    )
+
+
+def make_update(plan: GramPlan, metric: str, packed: bool = False):
     """Jitted ``(acc, block) -> acc`` with the plan's shardings pinned.
 
     The computation is byte-identical to the single-chip path; only the
     sharding annotations differ. XLA SPMD inserts the psum (variant mode)
     or slices the dots (tile2d) — no hand-written collectives, per the
     mesh/annotate/let-XLA-insert recipe.
-    """
-    acc_sh = _acc_shardings(plan, metric)
-    upd = (
-        gram_ops._update_grm_impl
-        if metric == "grm"
-        else partial(gram_ops._update_impl, pieces=gram_ops.PIECES_FOR_METRIC[metric])
-    )
-    jitted = jax.jit(
-        upd,
-        in_shardings=(acc_sh, plan.block_sharding),
-        out_shardings=acc_sh,
-        donate_argnums=(0,),
-    )
 
+    ``packed``: blocks arrive 2-bit packed ((N, v_blk/4) uint8,
+    ingest/bitpack.py) and are unpacked per-shard on device — in variant
+    mode the packed byte axis is what gets sharded, so each chip unpacks
+    only its own quarter-width slice.
+    """
+    jitted = _jitted_update(plan, metric, packed)
     n_shards = plan.mesh.devices.size if plan.mode == "variant" else 1
 
     def update(acc, block):
         if not (isinstance(block, jax.Array) and block.sharding == plan.block_sharding):
             block = np.asarray(block)
             if block.shape[1] % n_shards:
-                # Pad the variant axis to shardable width with MISSING —
-                # a missing call contributes zero to every gram piece, so
-                # this is semantically free (same trick as prefetch.pad_block).
-                from spark_examples_tpu.ingest.prefetch import pad_block
+                # Pad the variant axis to shardable width — a missing call
+                # (or a byte of four missing codes) contributes zero to
+                # every gram piece, so this is semantically free (same
+                # trick as prefetch.pad_block).
+                from spark_examples_tpu.ingest.prefetch import (
+                    pad_block, pad_packed,
+                )
 
                 width = -(-block.shape[1] // n_shards) * n_shards
-                block = pad_block(block, width)
+                block = (
+                    pad_packed(block, width) if packed
+                    else pad_block(block, width)
+                )
             block = jax.device_put(block, plan.block_sharding)
         return jitted(acc, block)
 

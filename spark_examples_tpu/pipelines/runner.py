@@ -88,7 +88,15 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
     meshes.maybe_init_distributed()
     mesh = meshes.make_mesh(shape=cfg.mesh_shape)
     plan = gram_sharded.plan_for(mesh, n, metric, cfg.gram_mode)
-    update = gram_sharded.make_update(plan, metric)
+    if cfg.pack_stream not in ("auto", "packed", "dense"):
+        raise ValueError(f"unknown pack_stream {cfg.pack_stream!r}")
+    # auto: pack only metrics whose inputs are dosages by definition —
+    # dot/euclidean may be fed arbitrary int8 tables the 2-bit codec
+    # would reject.
+    packed = cfg.pack_stream == "packed" or (
+        cfg.pack_stream == "auto" and metric in gram.DOSAGE_METRICS
+    )
+    update = gram_sharded.make_update(plan, metric, packed=packed)
 
     bv = job.ingest.block_variants
     start_variant = 0
@@ -109,11 +117,12 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
     with timer.phase("gram"):
         for block, meta in stream_to_device(
             source, bv, start_variant, sharding=plan.block_sharding,
-            pad_multiple=n_shards,
+            pad_multiple=n_shards, pack=packed,
         ):
             acc = update(acc, block)
-            timer.add("gram_flops", gram.flops_per_block(n, block.shape[1], metric))
-            timer.add("ingest_bytes", block.size)
+            v_eff = block.shape[1] * (4 if packed else 1)
+            timer.add("gram_flops", gram.flops_per_block(n, v_eff, metric))
+            timer.add("ingest_bytes", block.size)  # bytes actually shipped
             blocks_done += 1
             last_stop = meta.stop
             if (

@@ -26,6 +26,32 @@ def test_similarity_tpu_vs_cpu_backend_agree():
     assert tpu.sample_ids == cpu.sample_ids
 
 
+def test_packed_vs_dense_transport_agree():
+    """pack_stream=packed (the default via auto) is bit-identical to the
+    dense int8 transport, in both replicated and variant-sharded modes."""
+    for mode in ("replicated", "variant"):
+        packed = runner.run_similarity(
+            _job(metric="ibs", pack_stream="packed", gram_mode=mode)
+        )
+        dense = runner.run_similarity(
+            _job(metric="ibs", pack_stream="dense", gram_mode=mode)
+        )
+        np.testing.assert_array_equal(packed.distance, dense.distance)
+
+
+def test_auto_pack_keeps_nondosage_metrics_dense(rng):
+    """auto must not route arbitrary int8 tables through the 2-bit codec:
+    a dot-metric job over values outside the dosage domain still runs."""
+    x = rng.integers(0, 7, size=(12, 300)).astype(np.int8)  # counts, not dosages
+    job = _job(metric="dot")
+    res = runner.run_similarity(job, source=ArraySource(x))
+    # the dot metric's threshold decomposition clips dosages at 2 — what
+    # matters here is that the job runs (no 2-bit codec rejection) and
+    # matches the dense-transport semantics exactly
+    y = np.clip(x, 0, 2).astype(np.float64)
+    np.testing.assert_allclose(res.similarity, y @ y.T, rtol=1e-5)
+
+
 def test_pcoa_job_end_to_end_recovers_structure():
     job = _job(metric="ibs", num_pc=4)
     out = jobs.pcoa_job(job)
