@@ -93,7 +93,7 @@ def tpu_run(g: np.ndarray) -> dict:
             block = jax.lax.dynamic_slice(g_dev, (0, start), (n, BLOCK))
             return gram._update_impl(acc, block, pieces), None
 
-        acc0 = {k: jnp.zeros((n, n), jnp.float32) for k in pieces}
+        acc0 = {k: jnp.zeros((n, n), jnp.int32) for k in pieces}
         starts = jnp.arange(n_blocks) * BLOCK
         acc, _ = jax.lax.scan(body, acc0, starts)
         return acc

@@ -104,6 +104,18 @@ def load(path: str, metric: str, sample_ids: list[str],
             f"checkpoint at {path} was built for a different cohort "
             f"({manifest['n_samples']} samples)"
         )
+    from spark_examples_tpu.ops import gram
+
+    expected = sorted(
+        ("zz", "nvar") if metric == "grm" else gram.PIECES_FOR_METRIC[metric]
+    )
+    if manifest["leaves"] != expected:
+        raise ValueError(
+            f"checkpoint at {path} holds accumulator leaves "
+            f"{manifest['leaves']} but this version expects {expected} "
+            f"for metric {metric!r} (stale accumulator schema — delete "
+            "the checkpoint to restart)"
+        )
     acc = {
         k: jax.device_put(np.load(os.path.join(path, f"{k}.npy")))
         for k in manifest["leaves"]

@@ -19,36 +19,42 @@ from jax import lax
 
 
 def finalize(acc: dict, metric: str) -> dict[str, jnp.ndarray]:
-    """Accumulators -> {"similarity", "distance"} (N, N) f32 matrices.
+    """Raw-product accumulators -> {"similarity", "distance"} (N, N) f32.
 
-    IBS semantics follow the PLINK convention the reference family used:
-    over pairwise-complete variants, ``distance = sum|a-b| / (2 * m)`` and
-    ``similarity = 1 - distance``; pairs with zero shared valid variants
-    get distance 0 (they cannot be distinguished from identical — the
-    oracle encodes the same choice so parity tests pin it down).
+    Combines the streamed int32 matmul products into named statistics
+    (integer-exact — :func:`spark_examples_tpu.ops.gram.combine`), then
+    applies the metric's ratio/transform. IBS semantics follow the PLINK
+    convention the reference family used: over pairwise-complete
+    variants, ``distance = sum|a-b| / (2 * m)`` and ``similarity = 1 -
+    distance``; pairs with zero shared valid variants get distance 0
+    (they cannot be distinguished from identical — the oracle encodes the
+    same choice so parity tests pin it down).
     """
+    from spark_examples_tpu.ops import gram
+
+    stats = gram.combine(acc, metric)
     if metric == "ibs":
-        m = acc["m"]
-        dist = jnp.where(m > 0, acc["d1"] / (2.0 * m), 0.0)
+        m = stats["m"]
+        dist = jnp.where(m > 0, stats["d1"] / (2.0 * m), 0.0)
         return {"similarity": 1.0 - dist, "distance": dist}
     if metric == "ibs2":
-        m = acc["m"]
-        sim = jnp.where(m > 0, acc["ibs2"] / m, 1.0)
+        m = stats["m"]
+        sim = jnp.where(m > 0, stats["ibs2"] / (1.0 * m), 1.0)
         return {"similarity": sim, "distance": 1.0 - sim}
     if metric == "shared-alt":
         # The reference PCA driver's similarity: raw shared-alt-carrier
         # counts (centering happens downstream, SURVEY.md §3.1).
-        s = acc["s"]
+        s = stats["s"].astype(jnp.float32)
         return {"similarity": s, "distance": similarity_to_distance(s)}
     if metric == "euclidean":
-        d = jnp.sqrt(jnp.maximum(acc["e2"], 0.0))
+        d = jnp.sqrt(jnp.maximum(stats["e2"].astype(jnp.float32), 0.0))
         return {"similarity": -d, "distance": d}
     if metric == "grm":
-        g = acc["zz"] / jnp.maximum(acc["nvar"], 1.0)
+        g = stats["zz"] / jnp.maximum(stats["nvar"], 1.0)
         return {"similarity": g, "distance": similarity_to_distance(g)}
     if metric == "dot":
-        return {"similarity": acc["dot"],
-                "distance": similarity_to_distance(acc["dot"])}
+        dot = stats["dot"].astype(jnp.float32)
+        return {"similarity": dot, "distance": similarity_to_distance(dot)}
     raise ValueError(f"unknown metric {metric!r}")
 
 

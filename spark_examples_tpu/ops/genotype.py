@@ -5,36 +5,34 @@ pairwise similarity by *pair emission + reduceByKey*: for each variant,
 emit a count for every pair of samples sharing a genotype state, shuffle,
 and sum (SURVEY.md §3.1 HOT LOOP #2 — O(variants x carriers^2) pair
 emission). That shape is hostile to an MXU. The TPU-native reformulation
-turns the same counts into three matmuls.
+turns the same counts into a handful of matmuls.
 
 For a dosage block ``G`` of shape (N, V) with values {0, 1, 2, -1=missing},
-define int indicator matrices (computed in :func:`thresholds`):
+define int indicator matrices:
 
     C  = [G >= 0]   valid (non-missing) call
     T1 = [G >= 1]   carries at least one alt allele
     T2 = [G >= 2]   homozygous alt
 
-Every pairwise co-occurrence count the reference's reduceByKey produced is
-a bilinear form in {C, T1, T2} (one-hot states are X0 = C - T1,
-X1 = T1 - T2, X2 = T2):
+plus the derived operands Y = T1 + T2 (masked dosage, {0,1,2}) and
+Q = T1 + 3 T2 (masked squared dosage, {0,1,4}) that fold multiple
+indicator products into one matmul. Every pairwise co-occurrence count
+the reference's reduceByKey produced is a bilinear form in these
+operands; the *raw products* (``cc``, ``yc``, ``t1t1``, …) are what gets
+accumulated across blocks, and the final statistics (valid-pair count M,
+Manhattan sum D1, IBS2 count, squared euclidean, …) are assembled ONCE in
+:func:`combine_products` — not per block. Two wins:
 
-    valid pair count        M    = C  C^T
-    shared-alt count        S    = T1 T1^T            (the reference PCA
-                                   driver's similarity: #variants where
-                                   both samples carry >=1 alt)
-    sum of dosages a+b      A+A^T with A = (T1+T2) C^T
-    sum of min(a, b)        P    = T1 T1^T + T2 T2^T
-    Manhattan sum |a-b|     D1   = A + A^T - 2 P      (|a-b| = a+b-2min)
-    IBS2 count (a == b)     sum_g X_g X_g^T  — expands into the six
-                            products of {C, T1, T2}
+- the hot loop is pure matmul + add (no per-block N x N transposes or
+  combination algebra on the accumulators);
+- products of {0,1}/{0..4} int8 operands accumulate in **int32**, so
+  every count is *bit-exact* out to at least 2^29 variants (the worst
+  per-variant increment is 4, from yy/qc) — ~13x past the 40M-variant
+  north star, where f32 accumulators would round (f32 mantissa is
+  24 bits ≈ 1.7e7).
 
-so a *single* stacked matmul ``Z Z^T`` with ``Z = concat([C, T1, T2])``
-(or the six unique pairwise products in blocked form) yields every
-statistic. All downstream metrics (ops.distances) consume these Gram
-pieces; the full-matrix algebra never touches per-variant state again —
-exactly the associative-accumulation property the reference exploited via
-reduceByKey, now exploited via blocked FMA into an N x N accumulator
-(SURVEY.md §5 "Long-context": the 40M-variant axis is streamed).
+The 40M-long variant axis streams through in blocks and never
+materialises on device (SURVEY.md §5 "Long-context").
 """
 
 from __future__ import annotations
@@ -42,24 +40,45 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from spark_examples_tpu.core.dtypes import COMPUTE_DTYPE
+# raw product name -> (left operand, right operand); each is one
+# ``A B^T`` dot_general with int32 accumulation.
+PRODUCT_OPERANDS: dict[str, tuple[str, str]] = {
+    "cc": ("c", "c"),
+    "t1c": ("t1", "c"),
+    "yc": ("y", "c"),
+    "qc": ("q", "c"),
+    "yy": ("y", "y"),
+    "t1t1": ("t1", "t1"),
+    "t1t2": ("t1", "t2"),
+    "t2t2": ("t2", "t2"),
+}
+
+# statistic -> raw products it needs (mirrored by the CPU oracle).
+PIECE_PRODUCTS: dict[str, tuple[str, ...]] = {
+    "m": ("cc",),
+    "s": ("t1t1",),
+    "d1": ("yc", "t1t1", "t2t2"),
+    "ibs2": ("cc", "t1c", "t1t1", "t1t2", "t2t2"),
+    "dot": ("yy",),
+    "e2": ("qc", "yy"),
+}
 
 
-def thresholds(block: jnp.ndarray, dtype=COMPUTE_DTYPE):
-    """(N, V) int8 dosages -> stacked (3, N, V) indicators [C, T1, T2].
+def operands(block: jnp.ndarray, dtype=jnp.int8) -> dict[str, jnp.ndarray]:
+    """(N, V) int8 dosages -> the five matmul operands, int8.
 
-    Missing (-1) contributes zero to every indicator, which is what gives
+    Missing (-1) contributes zero to every operand, which is what gives
     the pairwise-complete semantics: a pair's statistics at a variant
     count only when *both* calls are valid (product of indicators).
     """
     c = (block >= 0).astype(dtype)
     t1 = (block >= 1).astype(dtype)
     t2 = (block >= 2).astype(dtype)
-    return jnp.stack([c, t1, t2])
+    return {"c": c, "t1": t1, "t2": t2, "y": t1 + t2, "q": t1 + 3 * t2}
 
 
 def _xxt(a: jnp.ndarray, b: jnp.ndarray, accum_dtype) -> jnp.ndarray:
-    """``a @ b^T`` with f32 MXU accumulation — one (N, V) x (V, N) dot."""
+    """``a @ b^T`` with on-MXU accumulation — one (N, V) x (V, N) dot."""
     return jax.lax.dot_general(
         a,
         b,
@@ -68,49 +87,90 @@ def _xxt(a: jnp.ndarray, b: jnp.ndarray, accum_dtype) -> jnp.ndarray:
     )
 
 
-def gram_pieces(block: jnp.ndarray, accum_dtype=jnp.float32) -> dict[str, jnp.ndarray]:
-    """Per-block contributions to the named pairwise statistics.
+def gram_products(
+    block: jnp.ndarray,
+    products: tuple[str, ...],
+    accum_dtype=jnp.int32,
+) -> dict[str, jnp.ndarray]:
+    """Per-block raw products: int8 operands, int32 (N, N) outputs.
 
-    Returns a dict of (N, N) f32 arrays:
+    Only the requested products' matmuls are emitted — IBS costs exactly
+    4 (cc, yc, t1t1, t2t2), shared-alt 1, euclidean 2. Each product is
+    additive across variant blocks, so the streaming driver FMAs them
+    into resident int32 accumulators — exact to >= 2^29 variants (worst
+    per-variant increment is 4, from yy/qc).
+
+    The optimization barrier materialises each operand once: without it,
+    XLA fuses the threshold computation into every dot's operand read, so
+    each indicator is recomputed by every matmul that consumes it and the
+    VPU work throttles the MXU pipeline (measured ~30% throughput loss on
+    the 4-product IBS update).
+    """
+    ops = operands(block)
+    used = sorted({o for p in products for o in PRODUCT_OPERANDS[p]})
+    ops = dict(zip(used, jax.lax.optimization_barrier(
+        tuple(ops[o] for o in used)
+    )))
+    return {
+        p: _xxt(ops[PRODUCT_OPERANDS[p][0]], ops[PRODUCT_OPERANDS[p][1]],
+                accum_dtype)
+        for p in products
+    }
+
+
+def combine_products(
+    prod: dict[str, jnp.ndarray], pieces: tuple[str, ...]
+) -> dict[str, jnp.ndarray]:
+    """Accumulated raw products -> named pairwise statistics.
+
+    Runs ONCE per job (inside finalize), in integer arithmetic — the
+    subtractions (e.g. D1 = YC + YC^T − 2(T1T1 + T2T2)) are exact, no
+    cancellation error. Each statistic:
+
       ``m``   — valid-pair counts            C C^T
       ``s``   — shared-alt counts            T1 T1^T
-      ``d1``  — Manhattan (sum |a-b|)        A + A^T - 2 P
-      ``ibs2``— exact-match counts           sum_g X_g X_g^T
-      ``dot`` — dosage inner products        Y Y^T (Y = masked dosage)
-      ``e2``  — squared euclidean over valid pairs
-
-    Dots are taken against *derived operands* where that saves MXU work:
-    Y = T1 + T2 (masked dosage) and Q = T1 + 3 T2 (masked squared dosage)
-    fold what would be two or three indicator products into one matmul —
-    e.g. sum of dosages over valid pairs is one Y C^T dot, and the
-    squared-euclidean piece is Q C^T + C Q^T - 2 Y Y^T, two dots total.
-    Every product is a separate ``dot_general`` so that, under ``jit``,
-    products feeding only unselected pieces are dead-code-eliminated:
-    IBS compiles to exactly 4 matmuls (C C^T, Y C^T, T1 T1^T, T2 T2^T),
-    euclidean to 2, the dosage Gram to 1.
-
-    Each piece is additive across variant blocks, so the streaming driver
-    just FMAs them into resident accumulators.
+      ``d1``  — Manhattan (sum |a-b|)        YC + YC^T − 2(T1T1 + T2T2)
+                (|a−b| = a+b−2·min(a,b); min-sum = T1T1^T + T2T2^T)
+      ``ibs2``— exact-match counts           Σ_g X_g X_g^T expanded into
+                indicator products (X0 = C−T1, X1 = T1−T2, X2 = T2)
+      ``dot`` — dosage inner products        Y Y^T
+      ``e2``  — squared euclidean            QC + QC^T − 2 Y Y^T
     """
-    c, t1, t2 = thresholds(block)
-    y = t1 + t2  # masked dosage: {0, 1, 2}, missing -> 0
-    q = t1 + 3.0 * t2  # masked squared dosage: {0, 1, 4}
+    out = {}
+    for piece in pieces:
+        if piece == "m":
+            out["m"] = prod["cc"]
+        elif piece == "s":
+            out["s"] = prod["t1t1"]
+        elif piece == "d1":
+            p = prod["t1t1"] + prod["t2t2"]
+            out["d1"] = prod["yc"] + _t(prod["yc"]) - 2 * p
+        elif piece == "ibs2":
+            out["ibs2"] = (
+                prod["cc"] - prod["t1c"] - _t(prod["t1c"])
+                + 2 * prod["t1t1"] - prod["t1t2"] - _t(prod["t1t2"])
+                + 2 * prod["t2t2"]
+            )
+        elif piece == "dot":
+            out["dot"] = prod["yy"]
+        elif piece == "e2":
+            out["e2"] = prod["qc"] + _t(prod["qc"]) - 2 * prod["yy"]
+        else:
+            raise ValueError(f"unknown gram piece {piece!r}")
+    return out
 
-    cc = _xxt(c, c, accum_dtype)
-    yc = _xxt(y, c, accum_dtype)
-    qc = _xxt(q, c, accum_dtype)
-    yy = _xxt(y, y, accum_dtype)
-    t1c = _xxt(t1, c, accum_dtype)
-    t1t1 = _xxt(t1, t1, accum_dtype)
-    t1t2 = _xxt(t1, t2, accum_dtype)
-    t2t2 = _xxt(t2, t2, accum_dtype)
 
-    p = t1t1 + t2t2  # sum of min(a, b) over valid pairs
-    d1 = yc + yc.T - 2.0 * p
-    # IBS2 = sum over one-hot states; expand (C-T1)(C-T1)^T + (T1-T2)(T1-T2)^T
-    # + T2 T2^T in indicator products.
-    ibs2 = (
-        cc - t1c.T - t1c + 2.0 * t1t1 - t1t2 - t1t2.T + 2.0 * t2t2
+def _t(a):
+    """Transpose that works for both jnp and np arrays."""
+    return a.T if hasattr(a, "T") else jnp.transpose(a)
+
+
+def gram_pieces(block: jnp.ndarray, accum_dtype=jnp.int32) -> dict[str, jnp.ndarray]:
+    """One-shot per-block statistics (all six) — test/oracle convenience;
+    the streaming path uses :func:`gram_products` + a single deferred
+    :func:`combine_products` instead."""
+    pieces = tuple(PIECE_PRODUCTS)
+    needed = tuple(
+        sorted({p for piece in pieces for p in PIECE_PRODUCTS[piece]})
     )
-    e2 = qc + qc.T - 2.0 * yy
-    return {"m": cc, "s": t1t1, "d1": d1, "ibs2": ibs2, "dot": yy, "e2": e2}
+    return combine_products(gram_products(block, needed, accum_dtype), pieces)

@@ -6,19 +6,24 @@ Reference semantics (SURVEY.md §3.1): per-variant pair emission →
 driver. The associativity that made reduceByKey work is the same property
 exploited here: every pairwise statistic is a sum over variants, so the
 driver streams (N, v_blk) dosage blocks through the chip and adds each
-block's :func:`~spark_examples_tpu.ops.genotype.gram_pieces` contribution
-into f32 accumulators resident in HBM. The 40M-variant axis never
-materialises on device — only one block plus the N x N state
-(SURVEY.md §5 "Long-context").
+block's raw matmul products
+(:func:`~spark_examples_tpu.ops.genotype.gram_products`) into **int32**
+accumulators resident in HBM. The combination algebra (Manhattan sums,
+IBS2 expansion — anything involving transposes or subtractions) runs once
+at finalize (:func:`combine`), not per block, so the hot loop is pure
+matmul + integer add: bit-exact to >= 2^29 variants (worst per-variant
+increment is 4) and free of per-block N x N relayouts. The 40M-variant axis never materialises on device — only
+one block plus the N x N state (SURVEY.md §5 "Long-context").
 
 Two block transforms live here:
 
-- :func:`update` — indicator-product pieces (IBS / shared-alt / euclidean
-  / IBS2 families, all pairwise-complete over missing data);
+- :func:`update` / :func:`update_packed` — raw-product accumulation for
+  the counting metrics (IBS / shared-alt / euclidean / IBS2 families, all
+  pairwise-complete over missing data);
 - :func:`update_grm` — the standardized-dosage GRM (VanRaden/GCTA form):
   per-variant allele frequency estimated *within the block*, dosages
   centered by 2p and scaled by 1/sqrt(2p(1-p)), missing mean-imputed to
-  zero contribution, accumulated as Z Z^T.
+  zero contribution, accumulated as Z Z^T in f32.
 """
 
 from __future__ import annotations
@@ -29,15 +34,23 @@ import jax
 import jax.numpy as jnp
 
 from spark_examples_tpu.core.dtypes import COMPUTE_DTYPE
-from spark_examples_tpu.ops.genotype import gram_pieces
+from spark_examples_tpu.ops import genotype
 
-# Which gram pieces each metric needs. Under jit, unused pieces (and the
-# indicator matmuls feeding only them) are dead-code-eliminated.
-# ("braycurtis" is NOT a gram metric — it is not a bilinear form; the
-# pipeline dispatches it to distances.braycurtis over dense tables.)
+# Which raw matmul products each metric accumulates. Each product is one
+# int8 x int8 -> int32 dot; the per-metric statistic is assembled from
+# them once, in combine().
 PIECES_FOR_METRIC: dict[str, tuple[str, ...]] = {
-    "ibs": ("d1", "m"),
-    "ibs2": ("ibs2", "m"),
+    "ibs": ("cc", "yc", "t1t1", "t2t2"),
+    "ibs2": ("cc", "t1c", "t1t1", "t1t2", "t2t2"),
+    "shared-alt": ("t1t1",),
+    "euclidean": ("qc", "yy"),
+    "dot": ("yy",),
+}
+
+# Statistics (genotype.combine_products names) each metric's finalize needs.
+STATS_FOR_METRIC: dict[str, tuple[str, ...]] = {
+    "ibs": ("m", "d1"),
+    "ibs2": ("m", "ibs2"),
     "shared-alt": ("s",),
     "euclidean": ("e2",),
     "dot": ("dot",),
@@ -45,15 +58,16 @@ PIECES_FOR_METRIC: dict[str, tuple[str, ...]] = {
 
 GRAM_METRICS = tuple(PIECES_FOR_METRIC) + ("grm",)
 
-# Unique matmuls each metric's selected pieces actually execute after
-# dead-code elimination (see gram_pieces): used for honest GFLOPS.
-_N_PRODUCTS = {"ibs": 4, "ibs2": 5, "shared-alt": 1, "euclidean": 2,
-               "dot": 1, "grm": 1}
+# Metrics whose inputs are genotype dosages *by definition* — safe to ship
+# 2-bit packed under pack_stream="auto". dot/euclidean accept arbitrary
+# int8 tables, so auto keeps them on the dense transport.
+DOSAGE_METRICS = ("ibs", "ibs2", "shared-alt", "grm")
 
 
 def flops_per_block(n: int, v: int, metric: str) -> float:
     """Matmul FLOPs one block contributes (for GFLOPS reporting)."""
-    return 2.0 * n * n * v * _N_PRODUCTS.get(metric, 6)
+    n_products = len(PIECES_FOR_METRIC.get(metric, ("zz",)))
+    return 2.0 * n * n * v * n_products
 
 
 def _check_metric(metric: str) -> None:
@@ -72,26 +86,14 @@ def init(n: int, metric: str) -> dict[str, jnp.ndarray]:
             "zz": jnp.zeros((n, n), jnp.float32),
             "nvar": jnp.zeros((), jnp.float32),
         }
-    pieces = PIECES_FOR_METRIC[metric]
-    return {k: jnp.zeros((n, n), jnp.float32) for k in pieces}
+    return {
+        k: jnp.zeros((n, n), jnp.int32) for k in PIECES_FOR_METRIC[metric]
+    }
 
 
 def _update_impl(acc, block, pieces: tuple[str, ...]):
-    g = gram_pieces(block)
+    g = genotype.gram_products(block, pieces)
     return {k: acc[k] + g[k] for k in pieces}
-
-
-_update = partial(jax.jit, static_argnames=("pieces",), donate_argnums=(0,))(
-    _update_impl
-)
-
-
-def update(acc: dict, block: jnp.ndarray, metric: str) -> dict:
-    """Add one (N, v_blk) int8 dosage block's contribution to ``acc``."""
-    _check_metric(metric)
-    if metric == "grm":
-        return update_grm(acc, block)
-    return _update(acc, block, PIECES_FOR_METRIC[metric])
 
 
 def _update_packed_impl(acc, packed, pieces: tuple[str, ...]):
@@ -106,10 +108,33 @@ def _update_packed_impl(acc, packed, pieces: tuple[str, ...]):
     return _update_impl(acc, unpack_dosages(packed), pieces)
 
 
-def _update_grm_packed_impl(acc: dict, packed) -> dict:
+def _update_grm_impl(acc: dict, block: jnp.ndarray, precise: bool = False) -> dict:
+    """VanRaden-form GRM accumulation with in-block allele frequencies.
+
+    ``precise``: run the Z Z^T product in f32 instead of bf16 — bf16
+    rounds GRM entries at ~1e-3 relative (the standardized dosages are
+    continuous, unlike the exact {0,1} indicators of the counting
+    metrics); f32 matmuls run at roughly half MXU rate.
+    """
+    valid = (block >= 0)
+    y = jnp.where(valid, block, 0).astype(jnp.float32)
+    cnt = valid.sum(axis=0).astype(jnp.float32)  # calls per variant
+    p = jnp.where(cnt > 0, y.sum(axis=0) / (2.0 * cnt), 0.0)
+    denom = 2.0 * p * (1.0 - p)
+    keep = (denom > 1e-8) & (cnt > 1)
+    scale = jnp.where(keep, jax.lax.rsqrt(jnp.maximum(denom, 1e-8)), 0.0)
+    dt = jnp.float32 if precise else COMPUTE_DTYPE
+    z = jnp.where(valid, (y - 2.0 * p) * scale, 0.0).astype(dt)
+    zz = jax.lax.dot_general(
+        z, z, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return {"zz": acc["zz"] + zz, "nvar": acc["nvar"] + keep.sum()}
+
+
+def _update_grm_packed_impl(acc: dict, packed, precise: bool = False) -> dict:
     from spark_examples_tpu.ingest.bitpack import unpack_dosages
 
-    return _update_grm_impl(acc, unpack_dosages(packed))
+    return _update_grm_impl(acc, unpack_dosages(packed), precise)
 
 
 def impl_for(metric: str, packed: bool):
@@ -123,13 +148,26 @@ def impl_for(metric: str, packed: bool):
     return partial(impl, pieces=PIECES_FOR_METRIC[metric])
 
 
+_update = partial(jax.jit, static_argnames=("pieces",), donate_argnums=(0,))(
+    _update_impl
+)
 _update_packed = partial(
     jax.jit, static_argnames=("pieces",), donate_argnums=(0,)
 )(_update_packed_impl)
-
-update_grm_packed = partial(jax.jit, donate_argnums=(0,))(
-    _update_grm_packed_impl
+update_grm = partial(jax.jit, static_argnames=("precise",), donate_argnums=(0,))(
+    _update_grm_impl
 )
+update_grm_packed = partial(
+    jax.jit, static_argnames=("precise",), donate_argnums=(0,)
+)(_update_grm_packed_impl)
+
+
+def update(acc: dict, block: jnp.ndarray, metric: str) -> dict:
+    """Add one (N, v_blk) int8 dosage block's contribution to ``acc``."""
+    _check_metric(metric)
+    if metric == "grm":
+        return update_grm(acc, block)
+    return _update(acc, block, PIECES_FOR_METRIC[metric])
 
 
 def update_packed(acc: dict, packed: jnp.ndarray, metric: str) -> dict:
@@ -140,26 +178,11 @@ def update_packed(acc: dict, packed: jnp.ndarray, metric: str) -> dict:
     return _update_packed(acc, packed, PIECES_FOR_METRIC[metric])
 
 
-# Metrics whose inputs are genotype dosages *by definition* — safe to ship
-# 2-bit packed under pack_stream="auto". dot/euclidean accept arbitrary
-# int8 tables, so auto keeps them on the dense transport.
-DOSAGE_METRICS = ("ibs", "ibs2", "shared-alt", "grm")
-
-
-def _update_grm_impl(acc: dict, block: jnp.ndarray) -> dict:
-    """VanRaden-form GRM accumulation with in-block allele frequencies."""
-    valid = (block >= 0)
-    y = jnp.where(valid, block, 0).astype(jnp.float32)
-    cnt = valid.sum(axis=0).astype(jnp.float32)  # calls per variant
-    p = jnp.where(cnt > 0, y.sum(axis=0) / (2.0 * cnt), 0.0)
-    denom = 2.0 * p * (1.0 - p)
-    keep = (denom > 1e-8) & (cnt > 1)
-    scale = jnp.where(keep, jax.lax.rsqrt(jnp.maximum(denom, 1e-8)), 0.0)
-    z = jnp.where(valid, (y - 2.0 * p) * scale, 0.0).astype(COMPUTE_DTYPE)
-    zz = jax.lax.dot_general(
-        z, z, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    return {"zz": acc["zz"] + zz, "nvar": acc["nvar"] + keep.sum()}
-
-
-update_grm = partial(jax.jit, donate_argnums=(0,))(_update_grm_impl)
+def combine(acc: dict, metric: str) -> dict[str, jnp.ndarray]:
+    """Accumulated raw products -> the named statistics ``finalize``
+    consumes (integer-exact; runs once per job). GRM accumulators pass
+    through unchanged (already in statistic form)."""
+    _check_metric(metric)
+    if metric == "grm":
+        return acc
+    return genotype.combine_products(acc, STATS_FOR_METRIC[metric])

@@ -207,14 +207,14 @@ def _run_similarity_cpu(job: JobConfig, source, timer: PhaseTimer) -> Similarity
     acc = {k: np.zeros((n, n)) for k in needed}
     with timer.phase("gram"):
         for block, _meta in source.blocks(job.ingest.block_variants):
-            pieces = oracle.cpu_gram_pieces(block, pieces=needed)
+            prods = oracle.cpu_gram_products(block, needed)
             for k in acc:
-                acc[k] += pieces[k]
+                acc[k] += prods[k]
             timer.add(
                 "gram_flops", gram.flops_per_block(n, block.shape[1], metric)
             )
     with timer.phase("finalize"):
-        out = oracle.cpu_finalize(acc, metric)
+        out = oracle.cpu_finalize(gram.combine(acc, metric), metric)
     return SimilarityResult(
         similarity=out["similarity"],
         distance=out["distance"],

@@ -107,59 +107,38 @@ def pca_mllib_route(similarity: np.ndarray, k: int = 10):
 
 # --------------------------------------------------------- cpu backend
 
-# Products each gram piece needs, using the same derived operands as the
-# TPU path (y = t1 + t2, q = t1 + 3 t2) — mirrors the DCE the jitted path
-# gets for free, keeping the measured CPU baseline honest.
-_PIECE_PRODUCTS = {
-    "m": ("cc",),
-    "s": ("t1t1",),
-    "d1": ("yc", "t1t1", "t2t2"),
-    "ibs2": ("cc", "t1c", "t1t1", "t1t2", "t2t2"),
-    "dot": ("yy",),
-    "e2": ("qc", "yy"),
-}
+
+def cpu_gram_products(genotypes: np.ndarray, products: tuple[str, ...]):
+    """Vectorized NumPy mirror of ops.genotype.gram_products (f64) — the
+    same derived operands (y = t1 + t2, q = t1 + 3 t2), so the measured
+    CPU baseline pays for exactly the matmuls the TPU path pays for."""
+    from spark_examples_tpu.ops.genotype import PRODUCT_OPERANDS, operands
+
+    ops = operands(genotypes, dtype=np.float64)
+    return {
+        p: ops[PRODUCT_OPERANDS[p][0]] @ ops[PRODUCT_OPERANDS[p][1]].T
+        for p in products
+    }
 
 
 def cpu_gram_pieces(genotypes: np.ndarray, pieces: tuple[str, ...] | None = None):
-    """Vectorized NumPy mirror of ops.genotype.gram_pieces (f64).
+    """Raw products + the shared combine step -> named statistics (f64).
 
-    ``pieces`` restricts both the outputs and the underlying matmuls to
-    what the requested statistics need.
+    Uses ops.genotype.combine_products directly (plain arithmetic, works
+    on NumPy arrays) so there is exactly one combination-algebra
+    implementation to keep correct.
     """
-    if pieces is None:
-        pieces = ("m", "s", "d1", "ibs2", "dot", "e2")
-    g = genotypes
-    c = (g >= 0).astype(np.float64)
-    t1 = (g >= 1).astype(np.float64)
-    t2 = (g >= 2).astype(np.float64)
-    y = t1 + t2
-    q = t1 + 3.0 * t2
-    ops = {"cc": (c, c), "t1c": (t1, c), "yc": (y, c), "qc": (q, c),
-           "yy": (y, y), "t1t1": (t1, t1), "t1t2": (t1, t2),
-           "t2t2": (t2, t2)}
-    needed = {p for piece in pieces for p in _PIECE_PRODUCTS[piece]}
-    prod = {name: a @ b.T for name, (a, b) in ops.items() if name in needed}
+    from spark_examples_tpu.ops.genotype import (
+        PIECE_PRODUCTS,
+        combine_products,
+    )
 
-    out = {}
-    for piece in pieces:
-        if piece == "m":
-            out["m"] = prod["cc"]
-        elif piece == "s":
-            out["s"] = prod["t1t1"]
-        elif piece == "d1":
-            p = prod["t1t1"] + prod["t2t2"]
-            out["d1"] = prod["yc"] + prod["yc"].T - 2.0 * p
-        elif piece == "ibs2":
-            out["ibs2"] = (
-                prod["cc"] - prod["t1c"] - prod["t1c"].T
-                + 2.0 * prod["t1t1"] - prod["t1t2"] - prod["t1t2"].T
-                + 2.0 * prod["t2t2"]
-            )
-        elif piece == "dot":
-            out["dot"] = prod["yy"]
-        elif piece == "e2":
-            out["e2"] = prod["qc"] + prod["qc"].T - 2.0 * prod["yy"]
-    return out
+    if pieces is None:
+        pieces = tuple(PIECE_PRODUCTS)
+    needed = tuple(
+        sorted({p for piece in pieces for p in PIECE_PRODUCTS[piece]})
+    )
+    return combine_products(cpu_gram_products(genotypes, needed), pieces)
 
 
 def cpu_ibs_distance(genotypes: np.ndarray) -> np.ndarray:

@@ -40,9 +40,29 @@ def test_blocked_accumulation_equals_single_shot(genotypes):
     acc = gram.init(n, "ibs")
     for start in range(0, v, 64):
         acc = gram.update(acc, genotypes[:, start : start + 64], "ibs")
+    stats = gram.combine(acc, "ibs")
     whole = genotype.gram_pieces(genotypes)
-    np.testing.assert_array_equal(np.asarray(acc["d1"]), np.asarray(whole["d1"]))
-    np.testing.assert_array_equal(np.asarray(acc["m"]), np.asarray(whole["m"]))
+    np.testing.assert_array_equal(np.asarray(stats["d1"]), np.asarray(whole["d1"]))
+    np.testing.assert_array_equal(np.asarray(stats["m"]), np.asarray(whole["m"]))
+
+
+def test_int32_accumulators_exact_past_f32_mantissa():
+    """North-star safety (40M variants): counts keep accumulating exactly
+    past 2^24, where f32 accumulators would round every odd increment
+    (f32 spacing at 2^24 is 2). int32 is exact to 2^31."""
+    import jax.numpy as jnp
+
+    n = 4
+    big = 2**24
+    acc = {k: jnp.full((n, n), big, jnp.int32)
+           for k in gram.PIECES_FOR_METRIC["ibs"]}
+    block = np.zeros((n, 3), np.int8)  # 3 valid hom-ref calls per sample
+    acc = gram.update(acc, block, "ibs")
+    assert acc["cc"].dtype == jnp.int32
+    # 2**24 + 3 is NOT representable in f32; int32 holds it exactly
+    np.testing.assert_array_equal(np.asarray(acc["cc"]), big + 3)
+    stats = gram.combine(acc, "ibs")
+    np.testing.assert_array_equal(np.asarray(stats["m"]), big + 3)
 
 
 def test_cpu_backend_matches_naive(genotypes):

@@ -115,12 +115,13 @@ def test_checkpoint_survives_crash_window(tmp_path):
 
     ids = [f"s{i}" for i in range(4)]
     path = str(tmp_path / "c")
-    ckpt.save(path, {"m": np.ones((4, 4))}, 64, "ibs", 64, ids)
+    acc0 = {k: np.ones((4, 4)) for k in ("cc", "yc", "t1t1", "t2t2")}
+    ckpt.save(path, acc0, 64, "ibs", 64, ids)
     # simulate the crash window: old moved aside, new never landed
     os.replace(path, path + ".old")
     acc, cursor = ckpt.load(path, "ibs", ids, block_variants=64)
     assert cursor == 64
-    np.testing.assert_array_equal(np.asarray(acc["m"]), np.ones((4, 4)))
+    np.testing.assert_array_equal(np.asarray(acc["cc"]), np.ones((4, 4)))
 
 
 @pytest.mark.parametrize(
@@ -187,9 +188,10 @@ def test_stream_to_device_pads_and_orders(genotypes):
         acc = gram.update(acc, b, "ibs")
     from spark_examples_tpu.ops.genotype import gram_pieces
 
+    stats = gram.combine(acc, "ibs")
     whole = gram_pieces(genotypes)
-    np.testing.assert_array_equal(np.asarray(acc["m"]), np.asarray(whole["m"]))
-    np.testing.assert_array_equal(np.asarray(acc["d1"]), np.asarray(whole["d1"]))
+    np.testing.assert_array_equal(np.asarray(stats["m"]), np.asarray(whole["m"]))
+    np.testing.assert_array_equal(np.asarray(stats["d1"]), np.asarray(whole["d1"]))
 
 
 def test_stream_to_device_propagates_errors():

@@ -73,8 +73,11 @@ def test_sharded_end_to_end_pcoa(rng, mesh):
     res = fit_pcoa(dist, k=3)
 
     ref_acc = _single_device_reference(g, "ibs", block=100)
+    ref_stats = {
+        k: np.asarray(v) for k, v in gram.combine(ref_acc, "ibs").items()
+    }
     ref_dist = np.where(
-        ref_acc["m"] > 0, ref_acc["d1"] / (2 * ref_acc["m"]), 0.0
+        ref_stats["m"] > 0, ref_stats["d1"] / (2 * ref_stats["m"]), 0.0
     )
     ref = fit_pcoa(ref_dist.astype(np.float32), k=3)
     np.testing.assert_allclose(
