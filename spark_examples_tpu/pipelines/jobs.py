@@ -80,6 +80,7 @@ def pcoa_job(
 
     n = dist.shape[0]
     if job.compute.backend == "cpu-reference":
+        method = "dense"
         with timer.phase("eigh"):
             coords, vals, _prop = oracle.pcoa(dist, k=k)
     else:
@@ -89,7 +90,9 @@ def pcoa_job(
                 fit_pcoa(dist.astype(np.float32), k=k, method=method)
             )
         coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
-    timer.add("eigh_flops", eigh_flops(n))
+    # FLOP credit must match the solver actually run (the randomized
+    # path's whole point is doing far fewer FLOPs than dense ~9n^3).
+    timer.add("eigh_flops", eigh_flops(n, method=method, k=k))
     out = CoordsOutput(sample_ids, coords, vals, timer, n_variants)
     if job.output_path:
         pio.write_coords_tsv(job.output_path, sample_ids, coords)
@@ -105,8 +108,9 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
     k = job.compute.num_pc
     if job.compute.backend == "cpu-reference":
         with sim.timer.phase("eigh"):
-            coords = oracle.pca_mllib_route(sim.similarity, k=k)
-            vals = np.zeros(k)
+            coords, vals = oracle.pca_mllib_route(
+                sim.similarity, k=k, return_values=True
+            )
     else:
         with sim.timer.phase("eigh"):
             res = hard_sync(

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import jax
 import numpy as np
 
 from spark_examples_tpu.core import checkpoint as ckpt
@@ -36,6 +37,11 @@ from spark_examples_tpu.ingest.prefetch import stream_to_device
 from spark_examples_tpu.ops import distances, gram
 from spark_examples_tpu.parallel import gram_sharded
 from spark_examples_tpu.utils import oracle
+
+
+# finalize is cheap math over N x N pieces, but run eagerly it dispatches
+# one tunnel round-trip per op — jit it once per metric.
+_finalize_jit = jax.jit(distances.finalize, static_argnames=("metric",))
 
 
 def build_source(cfg: IngestConfig):
@@ -138,7 +144,7 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
         acc = hard_sync(acc)
 
     with timer.phase("finalize"):
-        out = hard_sync(distances.finalize(acc, metric))
+        out = hard_sync(_finalize_jit(acc, metric))
     # The stream already counted the variants (meta.stop of the final
     # block) — avoid source.n_variants, which for VCF may re-parse the file.
     n_variants = last_stop if last_stop > 0 else source.n_variants

@@ -94,15 +94,28 @@ def pcoa(distance: np.ndarray, k: int = 10):
     return coords, vals, prop
 
 
-def pca_mllib_route(similarity: np.ndarray, k: int = 10):
+def pca_mllib_route(similarity: np.ndarray, k: int = 10,
+                    return_values: bool = False):
     """The reference's literal route (SURVEY.md §3.1): center, column
     covariance, eigenvectors, project rows. Used to pin the equivalence
-    claimed in models/pca.py."""
+    claimed in models/pca.py.
+
+    ``return_values``: also return the matrix eigenvalues of centered C
+    (signed, recovered as sqrt of the covariance spectrum times the sign
+    of the Rayleigh quotient) so the CPU backend reports a real spectrum.
+    """
     c = center_matrix(similarity.astype(np.float64))
     cov = (c.T @ c) / c.shape[0]
     vals, vecs = np.linalg.eigh(cov)
-    vecs = vecs[:, ::-1][:, :k]
-    return c @ vecs  # (N, k) projections
+    vals, vecs = vals[::-1][:k], vecs[:, ::-1][:, :k]
+    coords = c @ vecs  # (N, k) projections
+    if not return_values:
+        return coords
+    # cov = C^2 / n for symmetric C, so |lambda_C| = sqrt(n * lambda_cov);
+    # the sign is the Rayleigh quotient's sign.
+    signs = np.sign(np.einsum("ij,ij->j", vecs, coords))
+    matrix_vals = signs * np.sqrt(np.maximum(vals * c.shape[0], 0.0))
+    return coords, matrix_vals
 
 
 # --------------------------------------------------------- cpu backend

@@ -75,6 +75,12 @@ def test_pcoa_job_end_to_end_recovers_structure():
 def test_variants_pca_job_matches_mllib_route():
     out_tpu = jobs.variants_pca_job(_job(backend="jax-tpu", num_pc=3))
     out_cpu = jobs.variants_pca_job(_job(backend="cpu-reference", num_pc=3))
+    # the CPU route must report a real spectrum, matching the TPU one
+    assert not np.allclose(out_cpu.eigenvalues, 0.0)
+    np.testing.assert_allclose(
+        out_cpu.eigenvalues, out_tpu.eigenvalues,
+        rtol=1e-3, atol=1e-3 * np.abs(out_tpu.eigenvalues).max(),
+    )
     for c in range(3):
         a, b = out_tpu.coords[:, c], out_cpu.coords[:, c]
         assert np.allclose(a, b, atol=1e-2 * np.abs(a).max()) or np.allclose(
