@@ -59,6 +59,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="braycurtis lowering: elementwise VPU path or "
                    "threshold-decomposed MXU matmuls (quantised)")
     c.add_argument("--braycurtis-levels", type=int, default=256)
+    c.add_argument("--grm-precise", action="store_true",
+                   help="accumulate the GRM's Z Z^T in f32 instead of "
+                   "bf16 (half MXU rate, ~1e-3 better accuracy)")
     c.add_argument("--checkpoint-dir", default=None)
     c.add_argument("--checkpoint-every-blocks", type=int, default=0)
     p.add_argument("--output-path", default=None)
@@ -91,6 +94,7 @@ def _job_from_args(args) -> JobConfig:
             eigh_mode=args.eigh_mode,
             braycurtis_method=args.braycurtis_method,
             braycurtis_levels=args.braycurtis_levels,
+            grm_precise=args.grm_precise,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every_blocks=args.checkpoint_every_blocks,
         ),
@@ -120,6 +124,10 @@ def main(argv: list[str] | None = None) -> int:
 
     p_pca = sub.add_parser("pca", help="flagship variants-PCA driver")
     _add_common(p_pca)
+    # The PCA driver is defined on the shared-alt similarity (the
+    # reference's VariantsPcaDriver counting); any other --metric would
+    # be silently ignored, so reject it instead.
+    p_pca.set_defaults(metric="shared-alt")
 
     p_sv = sub.add_parser("search-variants",
                           help="genotype histograms at positions")
@@ -144,6 +152,12 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "coverage":
         return _run_coverage(args)
+    if args.command == "pca" and args.metric != "shared-alt":
+        parser.error(
+            f"pca computes the shared-alt similarity by definition; "
+            f"--metric {args.metric} is not accepted (use the similarity "
+            "or pcoa subcommands for other metrics)"
+        )
 
     job = _job_from_args(args)
 

@@ -37,8 +37,15 @@ def save(
     metric: str,
     block_variants: int,
     sample_ids: list[str],
+    stream_stats: dict | None = None,
 ) -> None:
-    """Atomically persist accumulators + resume cursor."""
+    """Atomically persist accumulators + resume cursor.
+
+    ``stream_stats``: the runner's producer-side stream statistics
+    (currently ``max_value``) — persisted so a resumed dot/euclidean
+    job's int32-exactness guard still sees the largest value of the
+    *whole* stream, not just the post-resume tail.
+    """
     tmp = path + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
@@ -52,6 +59,7 @@ def save(
         "sample_hash": _sample_hash(sample_ids),
         "n_samples": len(sample_ids),
         "leaves": sorted(acc.keys()),
+        "stream_stats": dict(stream_stats or {}),
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f)
@@ -70,7 +78,7 @@ def save(
 
 def load(path: str, metric: str, sample_ids: list[str],
          block_variants: int | None = None):
-    """Load (acc, next_variant) or None when absent/incompatible.
+    """Load (acc, next_variant, stream_stats) or None when absent.
 
     Incompatible checkpoints (different metric, cohort, or block grid)
     are rejected rather than silently mixed into the accumulation: a
@@ -120,4 +128,4 @@ def load(path: str, metric: str, sample_ids: list[str],
         k: jax.device_put(np.load(os.path.join(path, f"{k}.npy")))
         for k in manifest["leaves"]
     }
-    return acc, int(manifest["next_variant"])
+    return acc, int(manifest["next_variant"]), manifest.get("stream_stats", {})

@@ -69,19 +69,27 @@ class ComputeConfig:
     # Gram-path metrics: ibs | ibs2 | shared-alt | grm | euclidean | dot
     # (streamed genotype blocks). "braycurtis" is valid at the pipeline
     # level only — it dispatches to the dense-table distances.braycurtis
-    # path, not the gram accumulator.
-    metric: str = "ibs"
+    # path, not the gram accumulator. None means "the driver's default"
+    # (ibs for similarity/pcoa; the PCA driver always uses shared-alt) —
+    # a real sentinel, so drivers can tell an explicit choice from an
+    # unset field.
+    metric: str | None = None
     # braycurtis lowering: "exact" (VPU elementwise) or "matmul"
     # (threshold-decomposed MXU path, quantised to `braycurtis_levels`).
     braycurtis_method: str = "exact"
     braycurtis_levels: int = 256
     num_pc: int = 10
+    # GRM only: accumulate Z Z^T in f32 instead of bf16 — roughly half
+    # MXU rate for ~1e-3 better relative accuracy on the standardized
+    # (continuous) dosages. The integer metrics are exact regardless.
+    grm_precise: bool = False
     # Host->device block transport: "packed" ships 2-bit packed blocks
     # (4 dosages/byte, unpacked on device — ingest/bitpack.py); "dense"
     # ships int8. "auto" packs the metrics whose inputs are dosages by
-    # definition (ibs/ibs2/shared-alt/grm) and keeps dot/euclidean dense,
-    # since those may be fed arbitrary int8 tables the 2-bit codec would
-    # reject. Packed is exact for dosages {-1,0,1,2}.
+    # definition (ibs/ibs2/shared-alt/grm) and keeps dot/euclidean dense:
+    # they compute exact raw-value products for arbitrary int8 tables
+    # (values >= 0; negatives are missing), which the 2-bit codec cannot
+    # represent. Packed is exact for dosages {-1,0,1,2}.
     pack_stream: str = "auto"  # auto | packed | dense
     mesh_shape: tuple[int, int] | None = None  # None -> auto-factor devices
     gram_mode: str = "auto"  # auto | replicated | variant | tile2d

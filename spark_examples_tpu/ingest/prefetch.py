@@ -59,6 +59,7 @@ def stream_to_device(
     prefetch: int = 2,
     pad_multiple: int = 1,
     pack: bool = False,
+    stats: dict | None = None,
 ) -> Iterator[tuple[jax.Array, BlockMeta]]:
     """Yield device-resident, shape-stable (N, padded_width) blocks.
 
@@ -79,6 +80,12 @@ def stream_to_device(
     producer thread, overlapping the chip's FMA on the previous block. A
     source exposing ``packed_blocks`` (the 2-bit columnar store) is
     sliced zero-copy instead of being unpacked and re-packed.
+
+    ``stats``: optional dict the producer thread updates in place —
+    currently ``max_value`` (largest entry seen, dense transport only;
+    the packed codec's domain is bounded at 2 by construction). Feeds
+    the runner's int32-accumulator exactness guard for arbitrary int8
+    tables; computed off the critical path.
     """
     q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
     stop = threading.Event()
@@ -114,6 +121,10 @@ def stream_to_device(
                         return
             else:
                 for block, meta in source.blocks(block_variants, start_variant):
+                    if stats is not None and block.size:
+                        stats["max_value"] = max(
+                            stats.get("max_value", 0), int(block.max())
+                        )
                     if not _put((pad_block(block, width), meta)):
                         return
             _put(_END)

@@ -14,9 +14,10 @@ define int indicator matrices:
     T1 = [G >= 1]   carries at least one alt allele
     T2 = [G >= 2]   homozygous alt
 
-plus the derived operands Y = T1 + T2 (masked dosage, {0,1,2}) and
-Q = T1 + 3 T2 (masked squared dosage, {0,1,4}) that fold multiple
-indicator products into one matmul. Every pairwise co-occurrence count
+plus derived operands: Y = T1 + T2 (clipped dosage, {0,1,2} — used only
+by the dosage-defined IBS family), YR = the *raw* masked value (exact for
+arbitrary int8 tables, e.g. count matrices fed to ``dot``/``euclidean``)
+and QR = YR^2 (int16; up to 127^2). Every pairwise co-occurrence count
 the reference's reduceByKey produced is a bilinear form in these
 operands; the *raw products* (``cc``, ``yc``, ``t1t1``, …) are what gets
 accumulated across blocks, and the final statistics (valid-pair count M,
@@ -25,11 +26,18 @@ Manhattan sum D1, IBS2 count, squared euclidean, …) are assembled ONCE in
 
 - the hot loop is pure matmul + add (no per-block N x N transposes or
   combination algebra on the accumulators);
-- products of {0,1}/{0..4} int8 operands accumulate in **int32**, so
-  every count is *bit-exact* out to at least 2^29 variants (the worst
-  per-variant increment is 4, from yy/qc) — ~13x past the 40M-variant
-  north star, where f32 accumulators would round (f32 mantissa is
-  24 bits ≈ 1.7e7).
+- int8 operand products accumulate in **int32**, so every count is
+  *bit-exact* while ``max_increment * n_variants < 2^31``: for dosage
+  inputs the worst per-variant increment is 4 (yy/qc on {0,1,2}), i.e.
+  exact for **< 2^29 variants** — ~13x past the 40M-variant north star,
+  where f32 accumulators would round (f32 mantissa is 24 bits ≈ 1.7e7).
+  For arbitrary int8 tables with max value m the increment bound is m^2;
+  the streaming runner warns when a stream outruns its budget.
+
+The int16 QR operand never reaches the MXU directly: integer-accumulated
+paths split it radix-128 into two int8 halves (``qh``/``ql``) so the
+``qc`` product stays two full-rate int8 matmuls (see
+:func:`gram_products`).
 
 The 40M-long variant axis streams through in blocks and never
 materialises on device (SURVEY.md §5 "Long-context").
@@ -39,6 +47,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # raw product name -> (left operand, right operand); each is one
 # ``A B^T`` dot_general with int32 accumulation.
@@ -46,11 +55,18 @@ PRODUCT_OPERANDS: dict[str, tuple[str, str]] = {
     "cc": ("c", "c"),
     "t1c": ("t1", "c"),
     "yc": ("y", "c"),
-    "qc": ("q", "c"),
-    "yy": ("y", "y"),
+    "qc": ("qr", "c"),
+    "yy": ("yr", "yr"),
     "t1t1": ("t1", "t1"),
     "t1t2": ("t1", "t2"),
     "t2t2": ("t2", "t2"),
+}
+
+# Integer-path lowering of products whose left operand exceeds int8:
+# product -> weighted sum of int8-operand matmuls. qc = 128*(qh c^T)
+# + (ql c^T) with qh = qr >> 7, ql = qr & 127 keeps the MXU on int8.
+_INT8_SPLIT: dict[str, tuple[tuple[tuple[str, str], int], ...]] = {
+    "qc": ((("qh", "c"), 128), (("ql", "c"), 1)),
 }
 
 # statistic -> raw products it needs (mirrored by the CPU oracle).
@@ -65,16 +81,30 @@ PIECE_PRODUCTS: dict[str, tuple[str, ...]] = {
 
 
 def operands(block: jnp.ndarray, dtype=jnp.int8) -> dict[str, jnp.ndarray]:
-    """(N, V) int8 dosages -> the five matmul operands, int8.
+    """(N, V) int8 values -> the matmul operands.
 
-    Missing (-1) contributes zero to every operand, which is what gives
-    the pairwise-complete semantics: a pair's statistics at a variant
-    count only when *both* calls are valid (product of indicators).
+    Missing (any negative value) contributes zero to every operand, which
+    is what gives the pairwise-complete semantics: a pair's statistics at
+    a variant count only when *both* calls are valid (product of
+    indicators).
+
+    ``y`` (clipped dosage, T1+T2) serves the dosage-defined IBS family;
+    ``yr``/``qr`` carry the *raw* masked value and its square so that
+    ``dot``/``euclidean`` are exact for arbitrary int8 tables (counts up
+    to 127), not just dosages. ``qr`` is int16 on the integer path
+    (127^2 > int8); :func:`gram_products` splits it radix-128 back into
+    int8 before the MXU.
     """
-    c = (block >= 0).astype(dtype)
+    valid = block >= 0
+    c = valid.astype(dtype)
     t1 = (block >= 1).astype(dtype)
     t2 = (block >= 2).astype(dtype)
-    return {"c": c, "t1": t1, "t2": t2, "y": t1 + t2, "q": t1 + 3 * t2}
+    yr = (valid * block).astype(dtype)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        qr = yr.astype(np.int16) ** 2
+    else:
+        qr = yr * yr
+    return {"c": c, "t1": t1, "t2": t2, "y": t1 + t2, "yr": yr, "qr": qr}
 
 
 def _xxt(a: jnp.ndarray, b: jnp.ndarray, accum_dtype) -> jnp.ndarray:
@@ -95,10 +125,12 @@ def gram_products(
     """Per-block raw products: int8 operands, int32 (N, N) outputs.
 
     Only the requested products' matmuls are emitted — IBS costs exactly
-    4 (cc, yc, t1t1, t2t2), shared-alt 1, euclidean 2. Each product is
+    4 (cc, yc, t1t1, t2t2), shared-alt 1, euclidean 3 (qc is two int8
+    matmuls on the integer path — see ``_INT8_SPLIT``). Each product is
     additive across variant blocks, so the streaming driver FMAs them
-    into resident int32 accumulators — exact to >= 2^29 variants (worst
-    per-variant increment is 4, from yy/qc).
+    into resident int32 accumulators — exact while the per-variant
+    increment times the stream length stays under 2^31 (< 2^29 variants
+    for dosage inputs, whose worst increment is 4).
 
     The optimization barrier materialises each operand once: without it,
     XLA fuses the threshold computation into every dot's operand read, so
@@ -106,16 +138,37 @@ def gram_products(
     VPU work throttles the MXU pipeline (measured ~30% throughput loss on
     the 4-product IBS update).
     """
+    integer = np.issubdtype(np.dtype(accum_dtype), np.integer)
     ops = operands(block)
-    used = sorted({o for p in products for o in PRODUCT_OPERANDS[p]})
+    if integer:
+        # Radix-128 split keeps every MXU operand int8.
+        sq = ops.pop("qr")
+        ops["qh"] = (sq >> 7).astype(jnp.int8)
+        ops["ql"] = (sq & 127).astype(jnp.int8)
+        spec = {
+            p: _INT8_SPLIT.get(p, ((PRODUCT_OPERANDS[p], 1),))
+            for p in products
+        }
+    else:
+        dt = np.dtype(accum_dtype)
+        ops = {k: v.astype(dt) for k, v in ops.items()}
+        spec = {p: ((PRODUCT_OPERANDS[p], 1),) for p in products}
+    used = sorted(
+        {name for terms in spec.values() for (l, r), _ in terms
+         for name in (l, r)}
+    )
     ops = dict(zip(used, jax.lax.optimization_barrier(
         tuple(ops[o] for o in used)
     )))
-    return {
-        p: _xxt(ops[PRODUCT_OPERANDS[p][0]], ops[PRODUCT_OPERANDS[p][1]],
-                accum_dtype)
-        for p in products
-    }
+    out = {}
+    for p, terms in spec.items():
+        acc = None
+        for (l, r), w in terms:
+            prod = _xxt(ops[l], ops[r], accum_dtype)
+            prod = prod * w if w != 1 else prod
+            acc = prod if acc is None else acc + prod
+        out[p] = acc
+    return out
 
 
 def combine_products(
@@ -133,8 +186,10 @@ def combine_products(
                 (|a−b| = a+b−2·min(a,b); min-sum = T1T1^T + T2T2^T)
       ``ibs2``— exact-match counts           Σ_g X_g X_g^T expanded into
                 indicator products (X0 = C−T1, X1 = T1−T2, X2 = T2)
-      ``dot`` — dosage inner products        Y Y^T
-      ``e2``  — squared euclidean            QC + QC^T − 2 Y Y^T
+      ``dot`` — raw-value inner products     YR YR^T
+      ``e2``  — squared euclidean            QC + QC^T − 2 YR YR^T
+                (QC built from QR = YR^2, so both are exact for
+                arbitrary int8 values, not just dosages)
     """
     out = {}
     for piece in pieces:

@@ -11,8 +11,10 @@ block's raw matmul products
 accumulators resident in HBM. The combination algebra (Manhattan sums,
 IBS2 expansion — anything involving transposes or subtractions) runs once
 at finalize (:func:`combine`), not per block, so the hot loop is pure
-matmul + integer add: bit-exact to >= 2^29 variants (worst per-variant
-increment is 4) and free of per-block N x N relayouts. The 40M-variant axis never materialises on device — only
+matmul + integer add: bit-exact for < 2^29 variants on dosage inputs
+(worst per-variant increment is 4; arbitrary int8 tables have a m^2
+increment bound the runner checks) and free of per-block N x N
+relayouts. The 40M-variant axis never materialises on device — only
 one block plus the N x N state (SURVEY.md §5 "Long-context").
 
 Two block transforms live here:
@@ -59,15 +61,38 @@ STATS_FOR_METRIC: dict[str, tuple[str, ...]] = {
 GRAM_METRICS = tuple(PIECES_FOR_METRIC) + ("grm",)
 
 # Metrics whose inputs are genotype dosages *by definition* — safe to ship
-# 2-bit packed under pack_stream="auto". dot/euclidean accept arbitrary
-# int8 tables, so auto keeps them on the dense transport.
+# 2-bit packed under pack_stream="auto". dot/euclidean compute exact
+# raw-value products for arbitrary int8 tables (values >= 0; negatives are
+# missing), which the 2-bit codec cannot represent, so auto keeps them on
+# the dense transport.
 DOSAGE_METRICS = ("ibs", "ibs2", "shared-alt", "grm")
+
+# int32 accumulator budget: worst per-variant increment by metric, for
+# the runner's exactness guard (increment * n_variants must stay < 2^31).
+# dot/euclidean depend on the table's max value m (bound m^2); the value
+# here is the dosage-domain bound, the runner scales it by the observed
+# max when the stream is dense.
+MAX_INCREMENT: dict[str, int] = {
+    "ibs": 2,        # yc with y <= 2
+    "ibs2": 2,       # t1c-family indicator sums
+    "shared-alt": 1,
+    "euclidean": 4,  # qc/yy at dosage values; m^2 in general
+    "dot": 4,
+}
 
 
 def flops_per_block(n: int, v: int, metric: str) -> float:
-    """Matmul FLOPs one block contributes (for GFLOPS reporting)."""
-    n_products = len(PIECES_FOR_METRIC.get(metric, ("zz",)))
-    return 2.0 * n * n * v * n_products
+    """Matmul FLOPs one block contributes (for GFLOPS reporting).
+
+    Counts the matmuls the integer TPU path actually runs: products in
+    ``genotype._INT8_SPLIT`` (the radix-128 ``qc`` lowering) cost one
+    matmul per split term, so euclidean is 3, not 2.
+    """
+    n_matmuls = sum(
+        len(genotype._INT8_SPLIT.get(p, (None,)))
+        for p in PIECES_FOR_METRIC.get(metric, ("zz",))
+    )
+    return 2.0 * n * n * v * n_matmuls
 
 
 def _check_metric(metric: str) -> None:
@@ -137,13 +162,19 @@ def _update_grm_packed_impl(acc: dict, packed, precise: bool = False) -> dict:
     return _update_grm_impl(acc, unpack_dosages(packed), precise)
 
 
-def impl_for(metric: str, packed: bool):
+def impl_for(metric: str, packed: bool, grm_precise: bool = False):
     """The one dispatch point: unjitted ``(acc, block) -> acc`` for a
     metric/transport pair, pieces already bound. Every jitted wrapper
-    (here and the sharded planner) derives from this."""
+    (here and the sharded planner) derives from this.
+
+    ``grm_precise``: run the GRM's Z Z^T in f32 instead of bf16 (half
+    MXU rate, ~1e-3 better relative accuracy); ignored by the exact
+    integer metrics.
+    """
     _check_metric(metric)
     if metric == "grm":
-        return _update_grm_packed_impl if packed else _update_grm_impl
+        impl = _update_grm_packed_impl if packed else _update_grm_impl
+        return partial(impl, precise=grm_precise)
     impl = _update_packed_impl if packed else _update_impl
     return partial(impl, pieces=PIECES_FOR_METRIC[metric])
 

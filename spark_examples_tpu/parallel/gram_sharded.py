@@ -95,20 +95,23 @@ def init_sharded(plan: GramPlan, n: int, metric: str):
 
 
 @lru_cache(maxsize=64)
-def _jitted_update(plan: GramPlan, metric: str, packed: bool):
-    """One jit wrapper per (plan, metric, packed) — re-entering the same
-    job shape reuses the compiled executable instead of re-tracing (a
-    fresh ``jax.jit`` object owns a fresh compilation cache)."""
+def _jitted_update(plan: GramPlan, metric: str, packed: bool,
+                   grm_precise: bool = False):
+    """One jit wrapper per (plan, metric, packed, grm_precise) —
+    re-entering the same job shape reuses the compiled executable instead
+    of re-tracing (a fresh ``jax.jit`` object owns a fresh compilation
+    cache)."""
     acc_sh = _acc_shardings(plan, metric)
     return jax.jit(
-        gram_ops.impl_for(metric, packed),
+        gram_ops.impl_for(metric, packed, grm_precise),
         in_shardings=(acc_sh, plan.block_sharding),
         out_shardings=acc_sh,
         donate_argnums=(0,),
     )
 
 
-def make_update(plan: GramPlan, metric: str, packed: bool = False):
+def make_update(plan: GramPlan, metric: str, packed: bool = False,
+                grm_precise: bool = False):
     """Jitted ``(acc, block) -> acc`` with the plan's shardings pinned.
 
     The computation is byte-identical to the single-chip path; only the
@@ -121,7 +124,7 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False):
     mode the packed byte axis is what gets sharded, so each chip unpacks
     only its own quarter-width slice.
     """
-    jitted = _jitted_update(plan, metric, packed)
+    jitted = _jitted_update(plan, metric, packed, grm_precise)
     n_shards = plan.mesh.devices.size if plan.mode == "variant" else 1
 
     def update(acc, block):

@@ -100,7 +100,23 @@ def pcoa_job(
 
 
 def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
-    """The flagship driver: shared-alt similarity -> centered PCA."""
+    """The flagship driver: shared-alt similarity -> centered PCA.
+
+    The metric is fixed by the driver's definition (the reference's
+    VariantsPcaDriver counts shared alt carriers); a config explicitly
+    naming any other metric is warned about rather than silently
+    overridden (the CLI rejects it outright). ``metric=None`` — the
+    dataclass default — means "driver's choice" and is silent.
+    """
+    if job.compute.metric not in (None, "shared-alt"):
+        import warnings
+
+        warnings.warn(
+            f"variants_pca_job ignores compute.metric={job.compute.metric!r} "
+            "and always uses 'shared-alt'",
+            UserWarning,
+            stacklevel=2,
+        )
     job = job.replace(
         compute=dataclasses.replace(job.compute, metric="shared-alt")
     )

@@ -83,7 +83,7 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
         with timer.phase("ingest_setup"):
             source = build_source(job.ingest)
     n = source.n_samples
-    metric = cfg.metric
+    metric = cfg.metric or "ibs"  # None -> driver default
 
     if metric == "braycurtis":
         return _run_braycurtis(job, source, timer)
@@ -102,16 +102,26 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
     packed = cfg.pack_stream == "packed" or (
         cfg.pack_stream == "auto" and metric in gram.DOSAGE_METRICS
     )
-    update = gram_sharded.make_update(plan, metric, packed=packed)
+    update = gram_sharded.make_update(
+        plan, metric, packed=packed, grm_precise=cfg.grm_precise
+    )
 
     bv = job.ingest.block_variants
     start_variant = 0
     acc = None
+    # Only dot/euclidean consume the producer-side max (their int32
+    # budget depends on the table's values); other metrics skip the
+    # per-block host scan entirely.
+    stream_stats: dict | None = (
+        {} if metric in ("dot", "euclidean") and not packed else None
+    )
     if cfg.checkpoint_dir:
         restored = ckpt.load(cfg.checkpoint_dir, metric, source.sample_ids,
                              block_variants=bv)
         if restored is not None:
-            acc, start_variant = restored
+            acc, start_variant, saved_stats = restored
+            if stream_stats is not None:
+                stream_stats.update(saved_stats)
     if acc is None:
         acc = gram_sharded.init_sharded(plan, n, metric)
 
@@ -123,7 +133,7 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
     with timer.phase("gram"):
         for block, meta in stream_to_device(
             source, bv, start_variant, sharding=plan.block_sharding,
-            pad_multiple=n_shards, pack=packed,
+            pad_multiple=n_shards, pack=packed, stats=stream_stats,
         ):
             acc = update(acc, block)
             v_eff = block.shape[1] * (4 if packed else 1)
@@ -139,7 +149,7 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
                 hard_sync(acc)
                 ckpt.save(
                     cfg.checkpoint_dir, acc, meta.stop, metric, bv,
-                    source.sample_ids,
+                    source.sample_ids, stream_stats=stream_stats,
                 )
         acc = hard_sync(acc)
 
@@ -148,6 +158,9 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
     # The stream already counted the variants (meta.stop of the final
     # block) — avoid source.n_variants, which for VCF may re-parse the file.
     n_variants = last_stop if last_stop > 0 else source.n_variants
+    _check_int32_budget(
+        metric, n_variants, (stream_stats or {}).get("max_value", 2)
+    )
     return SimilarityResult(
         similarity=np.asarray(out["similarity"]),
         distance=np.asarray(out["distance"]),
@@ -156,6 +169,34 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
         timer=timer,
         n_variants=n_variants,
     )
+
+
+def _check_int32_budget(metric: str, n_variants: int, max_value: int) -> None:
+    """Warn when a stream outruns the int32 accumulators' exactness bound.
+
+    Counts are bit-exact while worst-per-variant-increment * n_variants
+    < 2^31 (ops/genotype.py): dosage metrics have fixed increment bounds
+    (gram.MAX_INCREMENT); dot/euclidean on arbitrary int8 tables are
+    bounded by max_value^2 (tracked by the prefetch producer). GRM
+    accumulates in f32 — rounding, not wraparound, is its failure mode —
+    so it is exempt.
+    """
+    if metric not in gram.MAX_INCREMENT:
+        return
+    inc = gram.MAX_INCREMENT[metric]
+    if metric in ("dot", "euclidean"):
+        inc = max(inc, max(1, int(max_value)) ** 2)
+    if inc * n_variants >= 2**31:
+        import warnings
+
+        warnings.warn(
+            f"metric {metric!r}: {n_variants} variants with per-variant "
+            f"increment bound {inc} exceeds the int32 accumulator budget "
+            f"(2^31) — pairwise counts may have wrapped; split the stream "
+            "into shorter jobs and merge finalized statistics instead",
+            RuntimeWarning,
+            stacklevel=2,
+        )
 
 
 def _materialize(source, block_variants: int) -> np.ndarray:
@@ -195,7 +236,7 @@ def _run_braycurtis(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResu
 
 def _run_similarity_cpu(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResult:
     """The measured CPU baseline (stand-in for Spark MLlib, SURVEY.md §5)."""
-    metric = job.compute.metric
+    metric = job.compute.metric or "ibs"
     n = source.n_samples
     if metric == "grm":
         with timer.phase("gram"):

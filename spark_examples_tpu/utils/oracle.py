@@ -123,8 +123,11 @@ def pca_mllib_route(similarity: np.ndarray, k: int = 10,
 
 def cpu_gram_products(genotypes: np.ndarray, products: tuple[str, ...]):
     """Vectorized NumPy mirror of ops.genotype.gram_products (f64) — the
-    same derived operands (y = t1 + t2, q = t1 + 3 t2), so the measured
-    CPU baseline pays for exactly the matmuls the TPU path pays for."""
+    same derived operands (y = t1 + t2, yr = raw masked value, qr =
+    yr^2). For the IBS-family metrics the CPU baseline pays for exactly
+    the matmuls the TPU path pays for; the one asymmetry is ``qc``, which
+    f64 computes in a single matmul while the integer path splits it
+    radix-128 into two int8 matmuls (genotype._INT8_SPLIT)."""
     from spark_examples_tpu.ops.genotype import PRODUCT_OPERANDS, operands
 
     ops = operands(genotypes, dtype=np.float64)

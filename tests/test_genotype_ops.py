@@ -72,6 +72,36 @@ def test_cpu_backend_matches_naive(genotypes):
         np.testing.assert_allclose(got[k], want[k], err_msg=f"piece {k}")
 
 
+def test_dot_e2_exact_on_arbitrary_int8(rng):
+    """dot/e2 use raw-value operands: exact for count tables up to int8
+    max, not just the dosage domain (the other pieces are dosage-defined;
+    the naive oracle's raw-value dot/e2 are the contract here). Exercises
+    the radix-128 int8 split of the squared operand (values > 11 make
+    qr = v^2 overflow int8, so the split path is what's under test)."""
+    g = rng.integers(-1, 120, size=(9, 83)).astype(np.int8)
+    got = {k: np.asarray(v) for k, v in genotype.gram_pieces(g).items()}
+    want = oracle.naive_pairwise(g)
+    for k in ("dot", "e2", "m"):
+        np.testing.assert_array_equal(got[k], want[k], err_msg=f"piece {k}")
+
+
+def test_grm_precise_flag_tightens_accuracy(genotypes):
+    """impl_for(grm, precise) is reachable and f32 accumulation is at
+    least as close to the f64 oracle as the bf16 default."""
+    n = genotypes.shape[0]
+    want = oracle.naive_grm(genotypes)
+
+    def run(precise):
+        impl = gram.impl_for("grm", packed=False, grm_precise=precise)
+        acc = impl(gram.init(n, "grm"), genotypes)
+        return np.asarray(acc["zz"] / np.maximum(np.asarray(acc["nvar"]), 1.0))
+
+    err_bf16 = np.abs(run(False) - want).max()
+    err_f32 = np.abs(run(True) - want).max()
+    assert err_f32 <= err_bf16
+    np.testing.assert_allclose(run(True), want, rtol=1e-4, atol=1e-4)
+
+
 def test_grm_matches_naive(genotypes):
     acc = gram.init(genotypes.shape[0], "grm")
     acc = gram.update(acc, genotypes, "grm")

@@ -119,7 +119,7 @@ def test_checkpoint_survives_crash_window(tmp_path):
     ckpt.save(path, acc0, 64, "ibs", 64, ids)
     # simulate the crash window: old moved aside, new never landed
     os.replace(path, path + ".old")
-    acc, cursor = ckpt.load(path, "ibs", ids, block_variants=64)
+    acc, cursor, _stats = ckpt.load(path, "ibs", ids, block_variants=64)
     assert cursor == 64
     np.testing.assert_array_equal(np.asarray(acc["cc"]), np.ones((4, 4)))
 

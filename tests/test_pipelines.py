@@ -40,16 +40,49 @@ def test_packed_vs_dense_transport_agree():
 
 
 def test_auto_pack_keeps_nondosage_metrics_dense(rng):
-    """auto must not route arbitrary int8 tables through the 2-bit codec:
-    a dot-metric job over values outside the dosage domain still runs."""
+    """auto must not route arbitrary int8 tables through the 2-bit codec,
+    and dot over a count table must be the TRUE dot product — raw-value
+    operands, not the dosage thresholds (which would clip at 2)."""
     x = rng.integers(0, 7, size=(12, 300)).astype(np.int8)  # counts, not dosages
     job = _job(metric="dot")
     res = runner.run_similarity(job, source=ArraySource(x))
-    # the dot metric's threshold decomposition clips dosages at 2 — what
-    # matters here is that the job runs (no 2-bit codec rejection) and
-    # matches the dense-transport semantics exactly
-    y = np.clip(x, 0, 2).astype(np.float64)
-    np.testing.assert_allclose(res.similarity, y @ y.T, rtol=1e-5)
+    np.testing.assert_allclose(
+        res.similarity, x.astype(np.float64) @ x.astype(np.float64).T,
+        rtol=1e-6,
+    )
+
+
+def test_euclidean_exact_on_count_table(rng):
+    """euclidean over arbitrary int8 values (beyond the dosage domain)
+    must equal the true pairwise euclidean distance."""
+    x = rng.integers(0, 50, size=(10, 200)).astype(np.int8)
+    res = runner.run_similarity(
+        _job(metric="euclidean"), source=ArraySource(x)
+    )
+    xf = x.astype(np.float64)
+    d2 = ((xf[:, None, :] - xf[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(res.distance, np.sqrt(d2), rtol=1e-6, atol=1e-6)
+
+
+def test_int32_budget_warning(rng):
+    """A stream whose worst-case increment budget is exceeded warns."""
+    import warnings
+
+    from spark_examples_tpu.pipelines.runner import _check_int32_budget
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _check_int32_budget("dot", n_variants=2**18, max_value=127)  # 127^2 * 2^18 > 2^31
+        assert len(w) == 1 and issubclass(w[0].category, RuntimeWarning)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _check_int32_budget("ibs", n_variants=2**29, max_value=2)  # 2 * 2^29 = 2^30 ok
+        _check_int32_budget("grm", n_variants=2**40, max_value=2)  # f32 path exempt
+        assert not w
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _check_int32_budget("ibs", n_variants=2**30, max_value=2)  # at the edge
+        assert len(w) == 1
 
 
 def test_pcoa_job_end_to_end_recovers_structure():
