@@ -1,0 +1,157 @@
+"""Tile2d-sharded finalize → center → randomized eigh → coordinates.
+
+The 76k-exome regime (BASELINE.md config 4) can *accumulate* its Gram
+tiles across the mesh (parallel/gram_sharded tile2d mode), but a 76k^2
+f32 matrix is ~23 GB — materialising it on one chip (or the host) for
+the downstream finalize/centering/eigensolve would undo the whole point
+of tiling. This module keeps every N x N intermediate tile2d-sharded
+(rows over mesh axis ``i``, cols over ``j``) from the raw accumulators
+all the way to the eigensolve, whose only large operations are
+``b @ q`` products — (N, N) x (N, k+p) matmuls that contract the
+column axis locally and psum over ``j`` (XLA SPMD inserts the
+collectives from the sharding annotations; no hand-written comms).
+
+Per-device residency is therefore O(N^2 / n_devices) for the matrix
+tiles plus O(N (k+p)) for the probe block — the (N, k+p) subspace is
+deliberately replicated (at 76k x 26 f32 it is ~8 MB, noise next to a
+2.9 GB tile).
+
+The combination algebra (transposes like ``yc + yc^T``) resolves to a
+mesh transpose of the tile grid — P(i, j) -> P(j, i) — which XLA lowers
+to an all-to-all over ICI, still never widening any single device's
+footprint beyond its tile.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.models.pcoa import PCoAResult
+from spark_examples_tpu.ops import distances
+from spark_examples_tpu.ops.centering import gower_center
+from spark_examples_tpu.ops.eigh import randomized_eigh
+from spark_examples_tpu.parallel.gram_sharded import GramPlan, _acc_shardings
+
+
+@lru_cache(maxsize=32)
+def _finalize_jit(plan: GramPlan, metric: str):
+    """acc (tile2d leaves) -> distance, kept tile2d."""
+    acc_sh = _acc_shardings(plan, metric)
+    return jax.jit(
+        lambda acc: distances.finalize(acc, metric)["distance"],
+        in_shardings=(acc_sh,),
+        out_shardings=plan.acc_sharding,
+        donate_argnums=(0,),
+    )
+
+
+@lru_cache(maxsize=32)
+def _center_jit(plan: GramPlan):
+    """distance (tile2d) -> Gower-centered B, kept tile2d. Row/col mean
+    subtraction is two sharded reductions (psum over one mesh axis
+    each); nothing widens."""
+    return jax.jit(
+        gower_center,
+        in_shardings=(plan.acc_sharding,),
+        out_shardings=plan.acc_sharding,
+        donate_argnums=(0,),
+    )
+
+
+@lru_cache(maxsize=32)
+def _eigh_jit(plan: GramPlan, k: int, oversample: int, iters: int):
+    """B (tile2d) -> (vals, vecs) replicated.
+
+    The algorithm is exactly ops.eigh.randomized_eigh — the only
+    difference is the sharding contract: B stays tiled, the (N, k+p)
+    subspace iterates replicated, and every B @ Q is a sharded matmul
+    (local contraction + psum over mesh axis j). QR/eigh of the skinny
+    (N, p)/(p, p) blocks run replicated — at 76k x 26 that is ~100
+    MFLOP, irrelevant next to the 2 N^2 p matmuls.
+    """
+    repl = meshes.replicated(plan.mesh)
+
+    def solve(b, key):
+        vals, vecs = randomized_eigh.__wrapped__(
+            b, k, key, oversample=oversample, iters=iters
+        )
+        # Total inertia (sum of all eigenvalues) for proportion-explained
+        # — computed here so `b` can be donated and freed.
+        return vals, vecs, jnp.trace(b)
+
+    return jax.jit(
+        solve,
+        in_shardings=(plan.acc_sharding, repl),
+        out_shardings=(repl, repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def assert_tiled(x: jax.Array, plan: GramPlan, what: str) -> None:
+    """Assert an N x N stage output is genuinely tile2d-sharded: every
+    addressable shard holds a proper tile, never the full matrix."""
+    n_i, n_j = plan.mesh.devices.shape
+    if n_i * n_j == 1:
+        return  # single device: tiling is vacuous
+    n, m = x.shape
+    want = (n // n_i, m // n_j)
+    for sh in x.addressable_shards:
+        if sh.data.shape != want:
+            raise AssertionError(
+                f"{what}: shard on {sh.device} has shape {sh.data.shape}, "
+                f"want tile {want} — a full-size leaf landed on one device"
+            )
+
+
+def pcoa_coords_sharded(
+    plan: GramPlan,
+    acc: dict,
+    metric: str,
+    k: int = 10,
+    key: jax.Array | None = None,
+    oversample: int = 16,
+    iters: int = 4,
+    check_shardings: bool = True,
+    timer=None,
+) -> PCoAResult:
+    """Raw tile2d accumulators -> PCoA coordinates, no full N x N leaf
+    on any single device at any stage boundary.
+
+    Mirrors the dense route (finalize -> gower_center -> eigh -> coords,
+    SURVEY.md §3.3) stage for stage; small-N parity with that route is
+    pinned by tests/test_parallel.py. ``check_shardings`` verifies the
+    tile contract on every N x N stage output (cheap: metadata only).
+    ``timer``: optional PhaseTimer recording finalize/eigh phases (adds
+    a hard sync per phase boundary for honest wall-clock).
+
+    Every stage donates its N x N input (acc -> dist -> B -> eigh
+    scratch), so per-device peak stays ~one tile per live stage instead
+    of accumulating all of them; ``acc`` is consumed — callers must not
+    reuse it afterwards.
+    """
+    from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
+
+    if key is None:
+        key = jax.random.key(0)
+    if timer is None:
+        timer = PhaseTimer()
+    with timer.phase("finalize"):
+        dist = _finalize_jit(plan, metric)(acc)
+        if check_shardings:
+            assert_tiled(dist, plan, "finalize distance")
+        b = hard_sync(_center_jit(plan)(dist))
+        del dist  # donated into b
+    if check_shardings:
+        assert_tiled(b, plan, "gower-centered B")
+    with timer.phase("eigh"):
+        vals, vecs, trace = hard_sync(
+            _eigh_jit(plan, k, oversample, iters)(b, key)
+        )
+    pos = jnp.maximum(vals, 0.0)
+    coords = vecs * jnp.sqrt(pos)[None, :]
+    prop = pos / jnp.maximum(trace, 1e-30)
+    return PCoAResult(coords, vals, prop)

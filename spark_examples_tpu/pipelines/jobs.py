@@ -74,7 +74,18 @@ def pcoa_job(
         timer = PhaseTimer()
         n_variants = 0
     else:
+        timer = PhaseTimer()
+        if source is None:
+            with timer.phase("ingest_setup"):
+                from spark_examples_tpu.pipelines.runner import build_source
+
+                source = build_source(job.ingest)
+        routed = _pcoa_sharded_route(job, source, timer)
+        if routed is not None:
+            return routed
         sim = run_similarity(job, source=source)
+        # Fold the pre-route phases (ingest_setup) into the sim timer.
+        sim.timer.phases.update(timer.phases)
         sample_ids, dist, timer = sim.sample_ids, sim.distance, sim.timer
         n_variants = sim.n_variants
 
@@ -90,13 +101,48 @@ def pcoa_job(
                 fit_pcoa(dist.astype(np.float32), k=k, method=method)
             )
         coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
+    return _emit_coords(job, sample_ids, coords, vals, timer, n_variants,
+                        method=method)
+
+
+def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
+                 n_variants: int, method: str) -> CoordsOutput:
+    """Shared output tail of every PCoA route: solver-matched FLOP
+    credit, result assembly, optional TSV persistence."""
     # FLOP credit must match the solver actually run (the randomized
     # path's whole point is doing far fewer FLOPs than dense ~9n^3).
-    timer.add("eigh_flops", eigh_flops(n, method=method, k=k))
-    out = CoordsOutput(sample_ids, coords, vals, timer, n_variants)
+    timer.add("eigh_flops", eigh_flops(len(sample_ids), method=method,
+                                       k=job.compute.num_pc))
+    out = CoordsOutput(sample_ids, np.asarray(coords), np.asarray(vals),
+                       timer, n_variants)
     if job.output_path:
-        pio.write_coords_tsv(job.output_path, sample_ids, coords)
+        pio.write_coords_tsv(job.output_path, sample_ids, out.coords)
     return out
+
+
+def _pcoa_sharded_route(job: JobConfig, source, timer) -> CoordsOutput | None:
+    """The config-4 (76k-exome) route: when the plan tiles the N x N
+    accumulator over the mesh, keep EVERYTHING sharded — finalize,
+    centering, and the randomized eigensolve — so no device (or the
+    host) ever materializes the full matrix. Returns None when the job
+    runs one of the dense routes instead (caller reuses ``source``)."""
+    from spark_examples_tpu.pipelines import runner
+    from spark_examples_tpu.parallel.pcoa_sharded import pcoa_coords_sharded
+
+    cfg = job.compute
+    metric = cfg.metric or "ibs"
+    if cfg.backend == "cpu-reference" or metric == "braycurtis":
+        return None
+    if cfg.eigh_mode == "dense":
+        return None  # dense eigh requires the materialized matrix
+    plan = runner.plan_for_job(job, source)
+    if plan.mode != "tile2d":
+        return None
+    grun = runner.run_gram(job, source, timer, plan=plan)
+    res = pcoa_coords_sharded(plan, grun.acc, metric, k=cfg.num_pc,
+                              timer=timer)
+    return _emit_coords(job, grun.sample_ids, res.coords, res.eigenvalues,
+                        timer, grun.n_variants, method="randomized")
 
 
 def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:

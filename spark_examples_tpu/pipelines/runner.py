@@ -74,31 +74,47 @@ class SimilarityResult:
     n_variants: int
 
 
-def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
-    """Stream the cohort and produce the pairwise similarity + distance
-    matrices (the SimilarityMatrix job surface, SURVEY.md §3.2)."""
-    timer = PhaseTimer()
-    cfg = job.compute
-    if source is None:
-        with timer.phase("ingest_setup"):
-            source = build_source(job.ingest)
-    n = source.n_samples
-    metric = cfg.metric or "ibs"  # None -> driver default
+@dataclass
+class GramRun:
+    """A finished accumulation whose N x N state is still on-device,
+    laid out per ``plan`` — the handoff between the streaming stage and
+    either host materialization (run_similarity) or the fully-sharded
+    solve (parallel/pcoa_sharded, the 76k route where no host/device
+    ever sees the whole matrix)."""
 
-    if metric == "braycurtis":
-        return _run_braycurtis(job, source, timer)
+    acc: dict
+    plan: gram_sharded.GramPlan
+    sample_ids: list[str]
+    metric: str
+    timer: PhaseTimer
+    n_variants: int
 
-    if cfg.backend == "cpu-reference":
-        return _run_similarity_cpu(job, source, timer)
 
+def plan_for_job(job: JobConfig, source) -> gram_sharded.GramPlan:
+    """The distribution plan this job will run under (mesh + mode)."""
     meshes.maybe_init_distributed()
-    mesh = meshes.make_mesh(shape=cfg.mesh_shape)
-    plan = gram_sharded.plan_for(mesh, n, metric, cfg.gram_mode)
+    mesh = meshes.make_mesh(shape=job.compute.mesh_shape)
+    metric = job.compute.metric or "ibs"
+    return gram_sharded.plan_for(
+        mesh, source.n_samples, metric, job.compute.gram_mode
+    )
+
+
+def run_gram(job: JobConfig, source, timer: PhaseTimer,
+             plan: gram_sharded.GramPlan | None = None) -> GramRun:
+    """Stream the cohort through the sharded accumulator (the reference's
+    pair-emit/reduceByKey stage). Device-resident result; finalization is
+    the caller's choice of route."""
+    cfg = job.compute
+    n = source.n_samples
+    metric = cfg.metric or "ibs"
+    if plan is None:
+        plan = plan_for_job(job, source)
     if cfg.pack_stream not in ("auto", "packed", "dense"):
         raise ValueError(f"unknown pack_stream {cfg.pack_stream!r}")
     # auto: pack only metrics whose inputs are dosages by definition —
-    # dot/euclidean may be fed arbitrary int8 tables the 2-bit codec
-    # would reject.
+    # dot/euclidean accept arbitrary int8 tables the 2-bit codec cannot
+    # represent.
     packed = cfg.pack_stream == "packed" or (
         cfg.pack_stream == "auto" and metric in gram.DOSAGE_METRICS
     )
@@ -127,7 +143,7 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
 
     # Variant-sharded placement needs the variant axis divisible by the
     # mesh size; padding with MISSING is semantically free.
-    n_shards = mesh.devices.size if plan.mode == "variant" else 1
+    n_shards = plan.mesh.devices.size if plan.mode == "variant" else 1
     blocks_done = 0
     last_stop = start_variant
     with timer.phase("gram"):
@@ -153,21 +169,41 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
                 )
         acc = hard_sync(acc)
 
-    with timer.phase("finalize"):
-        out = hard_sync(_finalize_jit(acc, metric))
     # The stream already counted the variants (meta.stop of the final
     # block) — avoid source.n_variants, which for VCF may re-parse the file.
     n_variants = last_stop if last_stop > 0 else source.n_variants
     _check_int32_budget(
         metric, n_variants, (stream_stats or {}).get("max_value", 2)
     )
+    return GramRun(acc, plan, source.sample_ids, metric, timer, n_variants)
+
+
+def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
+    """Stream the cohort and produce the pairwise similarity + distance
+    matrices (the SimilarityMatrix job surface, SURVEY.md §3.2)."""
+    timer = PhaseTimer()
+    cfg = job.compute
+    if source is None:
+        with timer.phase("ingest_setup"):
+            source = build_source(job.ingest)
+    metric = cfg.metric or "ibs"  # None -> driver default
+
+    if metric == "braycurtis":
+        return _run_braycurtis(job, source, timer)
+
+    if cfg.backend == "cpu-reference":
+        return _run_similarity_cpu(job, source, timer)
+
+    g = run_gram(job, source, timer)
+    with timer.phase("finalize"):
+        out = hard_sync(_finalize_jit(g.acc, metric))
     return SimilarityResult(
         similarity=np.asarray(out["similarity"]),
         distance=np.asarray(out["distance"]),
-        sample_ids=source.sample_ids,
+        sample_ids=g.sample_ids,
         metric=metric,
         timer=timer,
-        n_variants=n_variants,
+        n_variants=g.n_variants,
     )
 
 

@@ -84,6 +84,86 @@ def test_hard_sync_forces_every_shard(mesh, monkeypatch):
     assert len({f.device for f in fetched}) == 8
 
 
+def test_tile2d_sharded_solve_matches_dense(rng, mesh):
+    """The config-4 route: finalize -> center -> randomized eigh with
+    every N x N stage tile2d-sharded must agree with the dense path, and
+    the tile contract must hold at each stage boundary (the built-in
+    assert_tiled checks raise on any full-size leaf)."""
+    from spark_examples_tpu.models.pcoa import fit_pcoa
+    from spark_examples_tpu.parallel import pcoa_sharded
+
+    n = 64
+    g = random_genotypes(rng, n=n, v=480, missing_rate=0.1)
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    acc = gram_sharded.init_sharded(plan, n, "ibs")
+    update = gram_sharded.make_update(plan, "ibs")
+    for s in range(0, 480, 96):
+        acc = update(acc, g[:, s : s + 96])
+
+    res = pcoa_sharded.pcoa_coords_sharded(plan, acc, "ibs", k=4)
+
+    ref_acc = _single_device_reference(g, "ibs", block=96)
+    ref_dist = np.asarray(
+        distances.finalize(
+            {k: np.asarray(v) for k, v in ref_acc.items()}, "ibs"
+        )["distance"]
+    )
+    # Dense route with the same randomized solver, same key and params.
+    ref = fit_pcoa(ref_dist.astype(np.float32), k=4, method="randomized")
+    np.testing.assert_allclose(
+        np.asarray(res.eigenvalues), np.asarray(ref.eigenvalues),
+        rtol=1e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.abs(np.asarray(res.coords)), np.abs(np.asarray(ref.coords)),
+        rtol=1e-2, atol=1e-3,
+    )
+    # And the randomized solve itself must track the exact dense eigh.
+    exact = fit_pcoa(ref_dist.astype(np.float32), k=4, method="dense")
+    np.testing.assert_allclose(
+        np.asarray(res.eigenvalues), np.asarray(exact.eigenvalues),
+        rtol=1e-2, atol=1e-3,
+    )
+
+
+def test_assert_tiled_rejects_replicated(mesh):
+    from spark_examples_tpu.parallel import pcoa_sharded
+
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    full = jax.device_put(np.zeros((16, 16)), meshes.replicated(mesh))
+    with pytest.raises(AssertionError, match="full-size leaf"):
+        pcoa_sharded.assert_tiled(full, plan, "test")
+
+
+def test_pcoa_job_tile2d_route_matches_variant_route(rng):
+    """pcoa_job with gram_mode=tile2d takes the fully-sharded solve and
+    must produce the same coordinates as the variant-mode dense route."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.pipelines import jobs
+
+    def run(mode, eigh_mode):
+        job = JobConfig(
+            ingest=IngestConfig(source="synthetic", n_samples=48,
+                                n_variants=1500, block_variants=512, seed=9),
+            compute=ComputeConfig(metric="ibs", num_pc=3, gram_mode=mode,
+                                  eigh_mode=eigh_mode),
+        )
+        return jobs.pcoa_job(job)
+
+    tiled = run("tile2d", "randomized")
+    dense = run("variant", "randomized")
+    np.testing.assert_allclose(
+        tiled.eigenvalues, dense.eigenvalues, rtol=1e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.abs(tiled.coords), np.abs(dense.coords), rtol=1e-2, atol=1e-3
+    )
+    # the sharded route records the same phase structure
+    assert "eigh" in tiled.timer.phases and "gram" in tiled.timer.phases
+
+
 def test_sharded_end_to_end_pcoa(rng, mesh):
     """Sharded accumulate -> finalize -> PCoA equals unsharded run."""
     from spark_examples_tpu.models.pcoa import fit_pcoa
