@@ -121,6 +121,10 @@ def main(argv: list[str] | None = None) -> int:
                         choices=["auto", "distance", "similarity"],
                         help="what the persisted matrix holds (auto: trust "
                         "the file's sidecar, else assume distance)")
+    p_pcoa.add_argument("--stream-refresh-blocks", type=int, default=0,
+                        help="streaming mode: emit coordinate snapshots "
+                        "every N blocks via warm rank-k subspace "
+                        "refreshes (incremental PCoA)")
 
     p_pca = sub.add_parser("pca", help="flagship variants-PCA driver")
     _add_common(p_pca)
@@ -189,9 +193,28 @@ def main(argv: list[str] | None = None) -> int:
         )
         timer = res.timer
     elif args.command == "pcoa":
-        out = J.pcoa_job(job, matrix_path=args.matrix_path,
-                         matrix_kind=getattr(args, "matrix_kind", "auto"))
-        _print_coords(out, job)
+        refresh = getattr(args, "stream_refresh_blocks", 0)
+        if refresh > 0:
+            import dataclasses as _dc
+
+            from spark_examples_tpu.pipelines.streaming import (
+                incremental_pcoa_job,
+            )
+
+            if args.matrix_path:
+                parser.error("--stream-refresh-blocks streams the cohort; "
+                             "it cannot consume a persisted --matrix-path")
+            job = job.replace(compute=_dc.replace(
+                job.compute, stream_refresh_blocks=refresh))
+            out, snapshots = incremental_pcoa_job(job)
+            for s in snapshots:
+                print(f"snapshot@{s.n_variants} variants: "
+                      f"top eigenvalue {s.eigenvalues[0]:.6g}")
+            _print_coords(out, job)
+        else:
+            out = J.pcoa_job(job, matrix_path=args.matrix_path,
+                             matrix_kind=getattr(args, "matrix_kind", "auto"))
+            _print_coords(out, job)
         timer = out.timer
     elif args.command == "pca":
         out = J.variants_pca_job(job)

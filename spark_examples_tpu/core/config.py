@@ -94,6 +94,10 @@ class ComputeConfig:
     mesh_shape: tuple[int, int] | None = None  # None -> auto-factor devices
     gram_mode: str = "auto"  # auto | replicated | variant | tile2d
     eigh_mode: str = "auto"  # auto | dense | randomized
+    # Streaming incremental PCoA (config 5): emit coordinate snapshots
+    # every this many blocks via warm rank-k subspace refreshes; 0 runs
+    # the plain terminal solve.
+    stream_refresh_blocks: int = 0
     checkpoint_dir: str | None = None
     checkpoint_every_blocks: int = 0  # 0 disables partial-Gram checkpoints
 

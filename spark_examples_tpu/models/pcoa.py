@@ -16,7 +16,11 @@ import jax
 import jax.numpy as jnp
 
 from spark_examples_tpu.ops.centering import gower_center
-from spark_examples_tpu.ops.eigh import randomized_eigh, top_k_eigh
+from spark_examples_tpu.ops.eigh import (
+    coords_from_eigpairs,
+    randomized_eigh,
+    top_k_eigh,
+)
 
 
 @dataclass
@@ -34,9 +38,8 @@ def _fit(distance, k, method, key):
         vals, vecs = top_k_eigh(b, k)
     else:
         vals, vecs = randomized_eigh(b, k, key)
-    pos = jnp.maximum(vals, 0.0)
-    coords = vecs * jnp.sqrt(pos)[None, :]
-    prop = pos / jnp.maximum(trace, 1e-30)
+    coords = coords_from_eigpairs(vals, vecs)
+    prop = jnp.maximum(vals, 0.0) / jnp.maximum(trace, 1e-30)
     return coords, vals, prop
 
 

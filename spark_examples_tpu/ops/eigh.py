@@ -35,6 +35,57 @@ def top_k_eigh(b: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     return vals, vecs
 
 
+def _subspace_iterate_impl(b, q, k: int, iters: int):
+    def step(q, _):
+        q, _ = jnp.linalg.qr(b @ q)
+        return q, None
+
+    q, _ = jax.lax.scan(step, q, None, length=iters)
+    # Rayleigh quotient: small (p, p) symmetric problem.
+    t = q.T @ (b @ q)
+    t = 0.5 * (t + t.T)
+    vals, s = jnp.linalg.eigh(t)
+    vals_k = vals[::-1][:k]
+    vecs = (q @ s)[:, ::-1][:, :k]
+    return vals_k, vecs, q
+
+
+@partial(jax.jit, static_argnames=("k", "iters"))
+def subspace_iterate(
+    b: jnp.ndarray, q: jnp.ndarray, k: int, iters: int = 1
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``iters`` power steps from an existing (N, p) subspace ``q``, then
+    a Rayleigh solve: returns (vals (k,), vecs (N, k), q_new (N, p)).
+
+    This is the rank-k *incremental* eig building block (BASELINE.md
+    config 5): when ``b`` is a streaming accumulator that grows by a
+    small relative delta between calls, warm-starting from the previous
+    ``q`` needs only ``iters=1`` power step per refresh instead of a
+    full cold solve — subspace tracking, all matmul-shaped (the B @ Q
+    products tile onto the MXU and shard over the mesh like any Gram
+    block).
+    """
+    return _subspace_iterate_impl(b, q, k, iters)
+
+
+def init_probes(key: jax.Array, n: int, p: int, dtype=jnp.float32):
+    """Random (N, p) Gaussian probe block — the cold-start subspace.
+
+    ``p`` is clamped to N: a wider-than-square probe block would be
+    collapsed to (N, N) by reduced QR, changing the scan carry shape
+    mid-iteration (a crash, not an accuracy loss).
+    """
+    return jax.random.normal(key, (n, min(p, n)), dtype=dtype)
+
+
+def coords_from_eigpairs(vals: jnp.ndarray, vecs: jnp.ndarray) -> jnp.ndarray:
+    """coords_i = v_i * sqrt(max(lambda_i, 0)) — the PCoA convention:
+    negative eigenvalues (non-Euclidean distances) become zero
+    coordinate axes, matching scikit-bio's classical PCoA. The single
+    definition every route (dense, sharded, streaming) shares."""
+    return vecs * jnp.sqrt(jnp.maximum(vals, 0.0))[None, :]
+
+
 @partial(jax.jit, static_argnames=("k", "oversample", "iters"))
 def randomized_eigh(
     b: jnp.ndarray,
@@ -49,23 +100,11 @@ def randomized_eigh(
     for PCoA-class spectra (fast decay) is ample with the defaults. The
     only large-N operations are ``b @ q`` products — (N, N) x (N, k+p)
     matmuls that tile onto the MXU and shard cleanly over the mesh.
+    Cold start of :func:`subspace_iterate` (iters + 1 power steps from
+    random probes).
     """
-    n = b.shape[0]
-    p = k + oversample
-    q = jax.random.normal(key, (n, p), dtype=b.dtype)
-    q, _ = jnp.linalg.qr(b @ q)
-
-    def step(q, _):
-        q, _ = jnp.linalg.qr(b @ q)
-        return q, None
-
-    q, _ = jax.lax.scan(step, q, None, length=iters)
-    # Rayleigh quotient: small (p, p) symmetric problem.
-    t = q.T @ (b @ q)
-    t = 0.5 * (t + t.T)
-    vals, s = jnp.linalg.eigh(t)
-    vals = vals[::-1][:k]
-    vecs = (q @ s)[:, ::-1][:, :k]
+    q = init_probes(key, b.shape[0], k + oversample, b.dtype)  # p clamped to N
+    vals, vecs, _ = _subspace_iterate_impl(b, q, k, iters + 1)
     return vals, vecs
 
 

@@ -33,7 +33,7 @@ from spark_examples_tpu.core import meshes
 from spark_examples_tpu.models.pcoa import PCoAResult
 from spark_examples_tpu.ops import distances
 from spark_examples_tpu.ops.centering import gower_center
-from spark_examples_tpu.ops.eigh import randomized_eigh
+from spark_examples_tpu.ops.eigh import coords_from_eigpairs, randomized_eigh
 from spark_examples_tpu.parallel.gram_sharded import GramPlan, _acc_shardings
 
 
@@ -151,7 +151,6 @@ def pcoa_coords_sharded(
         vals, vecs, trace = hard_sync(
             _eigh_jit(plan, k, oversample, iters)(b, key)
         )
-    pos = jnp.maximum(vals, 0.0)
-    coords = vecs * jnp.sqrt(pos)[None, :]
-    prop = pos / jnp.maximum(trace, 1e-30)
+    coords = coords_from_eigpairs(vals, vecs)
+    prop = jnp.maximum(vals, 0.0) / jnp.maximum(trace, 1e-30)
     return PCoAResult(coords, vals, prop)

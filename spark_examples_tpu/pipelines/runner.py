@@ -101,10 +101,16 @@ def plan_for_job(job: JobConfig, source) -> gram_sharded.GramPlan:
 
 
 def run_gram(job: JobConfig, source, timer: PhaseTimer,
-             plan: gram_sharded.GramPlan | None = None) -> GramRun:
+             plan: gram_sharded.GramPlan | None = None,
+             on_block=None) -> GramRun:
     """Stream the cohort through the sharded accumulator (the reference's
     pair-emit/reduceByKey stage). Device-resident result; finalization is
-    the caller's choice of route."""
+    the caller's choice of route.
+
+    ``on_block(acc, blocks_done, meta)``: optional hook after each
+    block's update — the streaming incremental-PCoA driver refreshes
+    its eigpair sketch here. Must treat ``acc`` as read-only.
+    """
     cfg = job.compute
     n = source.n_samples
     metric = cfg.metric or "ibs"
@@ -157,6 +163,8 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
             timer.add("ingest_bytes", block.size)  # bytes actually shipped
             blocks_done += 1
             last_stop = meta.stop
+            if on_block is not None:
+                on_block(acc, blocks_done, meta)
             if (
                 cfg.checkpoint_dir
                 and cfg.checkpoint_every_blocks

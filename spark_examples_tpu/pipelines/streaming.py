@@ -1,0 +1,166 @@
+"""Streaming incremental PCoA — benchmark config 5.
+
+The reference family's aspiration (BASELINE.json:11): ingest streams in
+(the BigQuery path), and principal coordinates are available *during*
+the stream, not only after a terminal batch solve. The TPU-native design
+exploits two facts:
+
+- the Gram/similarity accumulator is resident and associative — after
+  any block its partial state is a valid (smaller-cohort-of-variants)
+  similarity matrix;
+- the eigensolve's randomized subspace (ops/eigh.subspace_iterate) can
+  be *warm-started*: between refreshes the accumulator changes by a
+  ~1/blocks_done relative delta, so tracking the top-k eigenspace needs
+  a single power step (two sharded B @ Q matmuls) per refresh instead
+  of a cold solve — this is the rank-k incremental eig update named by
+  the config.
+
+Every refresh is matmul-shaped and respects the gram plan's shardings
+(tile2d accumulators never widen). Snapshots are emitted every
+``stream_refresh_blocks`` blocks; the final coordinates take a few
+extra tightening iterations from the tracked subspace and must match a
+full recompute (pinned by tests/test_streaming.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import jax
+import numpy as np
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
+from spark_examples_tpu.ops import distances
+from spark_examples_tpu.ops.centering import gower_center
+from spark_examples_tpu.ops.eigh import (
+    coords_from_eigpairs,
+    init_probes,
+    subspace_iterate,
+)
+from spark_examples_tpu.parallel.gram_sharded import GramPlan, _acc_shardings
+from spark_examples_tpu.pipelines import runner as R
+from spark_examples_tpu.pipelines.jobs import CoordsOutput, _emit_coords
+
+OVERSAMPLE = 16
+FINAL_ITERS = 4  # tightening steps for the terminal solve
+
+
+@dataclass
+class StreamSnapshot:
+    """Coordinates emitted mid-stream, after ``n_variants`` variants."""
+
+    n_variants: int
+    eigenvalues: np.ndarray
+    coords: np.ndarray
+
+
+@lru_cache(maxsize=32)
+def _center_jit(plan: GramPlan, metric: str):
+    """acc -> Gower-centered B, plan-sharded. No donation: the live
+    accumulator keeps streaming after each refresh."""
+    return jax.jit(
+        lambda acc: gower_center(
+            distances.finalize(acc, metric)["distance"]
+        ),
+        in_shardings=(_acc_shardings(plan, metric),),
+        out_shardings=plan.acc_sharding,
+    )
+
+
+@lru_cache(maxsize=32)
+def _refresh_jit(plan: GramPlan, k: int, iters: int):
+    """(B, q) -> (vals, vecs, q_new): warm subspace refresh with the
+    N x N input plan-sharded and the skinny subspace replicated."""
+    repl = meshes.replicated(plan.mesh)
+    return jax.jit(
+        lambda b, q: subspace_iterate.__wrapped__(b, q, k, iters),
+        in_shardings=(plan.acc_sharding, repl),
+        out_shardings=(repl, repl, repl),
+    )
+
+
+def incremental_pcoa_job(
+    job, source=None
+) -> tuple[CoordsOutput, list[StreamSnapshot]]:
+    """PCoA with mid-stream coordinate snapshots (config 5).
+
+    Streams blocks through the sharded gram accumulator exactly like
+    ``pcoa_job``; every ``compute.stream_refresh_blocks`` blocks a
+    warm subspace refresh emits a snapshot. Returns the final
+    coordinates (tightened from the tracked subspace) plus the
+    snapshot history; refresh cost is visible as the ``stream_refresh``
+    timer phase, so its overhead over a plain streamed run is
+    measurable (bench config 5).
+    """
+    cfg = job.compute
+    refresh_every = cfg.stream_refresh_blocks
+    if refresh_every <= 0:
+        raise ValueError(
+            "incremental_pcoa_job requires compute.stream_refresh_blocks > 0"
+        )
+    metric = cfg.metric or "ibs"
+    if cfg.backend == "cpu-reference" or metric == "braycurtis":
+        raise ValueError(
+            "streaming pcoa runs on the jax backend with a gram metric"
+        )
+    if cfg.eigh_mode == "dense":
+        raise ValueError(
+            "streaming pcoa is the rank-k subspace path by construction; "
+            "eigh_mode='dense' would be silently ignored — use the batch "
+            "pcoa job for a dense solve"
+        )
+    timer = PhaseTimer()
+    if source is None:
+        with timer.phase("ingest_setup"):
+            source = R.build_source(job.ingest)
+    plan = R.plan_for_job(job, source)
+    k = cfg.num_pc
+    n = source.n_samples
+    center = _center_jit(plan, metric)
+    refresh = _refresh_jit(plan, k, iters=1)
+
+    q0 = init_probes(jax.random.key(0), n, k + OVERSAMPLE)  # p clamped to N
+    state = {
+        "q": jax.device_put(q0, meshes.replicated(plan.mesh)),
+        "snapshots": [],
+        # Last refresh's centered matrix + its variant cursor: when the
+        # stream ends exactly on a refresh boundary (the common case),
+        # the terminal solve reuses it instead of redoing a full N x N
+        # finalize+center on a byte-identical accumulator. Holding it
+        # does not raise peak residency — the same buffer is live during
+        # every refresh anyway — and it is dropped (overwritten) at the
+        # next refresh.
+        "b": None,
+        "b_variants": -1,
+    }
+
+    def on_block(acc, blocks_done, meta):
+        if blocks_done % refresh_every:
+            return
+        state["b"] = None  # free the previous B before building the next
+        with timer.phase("stream_refresh"):
+            b = center(acc)
+            vals, vecs, q = hard_sync(refresh(b, state["q"]))
+        state.update(q=q, b=b, b_variants=meta.stop)
+        v = np.asarray(vals)
+        coords = np.asarray(coords_from_eigpairs(vals, vecs))
+        state["snapshots"].append(StreamSnapshot(meta.stop, v, coords))
+
+    grun = R.run_gram(job, source, timer, plan=plan, on_block=on_block)
+
+    # Terminal solve: a few tightening iterations from the tracked
+    # subspace — warm, so far cheaper than a cold randomized solve.
+    final = _refresh_jit(plan, k, iters=FINAL_ITERS)
+    with timer.phase("eigh"):
+        if state["b_variants"] == grun.n_variants and state["b"] is not None:
+            b = state["b"]
+        else:
+            b = center(grun.acc)
+        vals, vecs, _q = hard_sync(final(b, state["q"]))
+    v = np.asarray(vals)
+    coords = np.asarray(coords_from_eigpairs(vals, vecs))
+    out = _emit_coords(job, grun.sample_ids, coords, v, timer,
+                       grun.n_variants, method="randomized")
+    return out, state["snapshots"]
