@@ -55,9 +55,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     c.add_argument("--eigh-mode", default="auto",
                    choices=["auto", "dense", "randomized"])
     c.add_argument("--braycurtis-method", default="exact",
-                   choices=["exact", "matmul"],
-                   help="braycurtis lowering: elementwise VPU path or "
-                   "threshold-decomposed MXU matmuls (quantised)")
+                   choices=["exact", "matmul", "pallas"],
+                   help="braycurtis lowering: elementwise VPU path, "
+                   "threshold-decomposed MXU matmuls (quantised), or the "
+                   "fused-VMEM Pallas kernel (interpreted on CPU)")
     c.add_argument("--braycurtis-levels", type=int, default=256)
     c.add_argument("--grm-precise", action="store_true",
                    help="accumulate the GRM's Z Z^T in f32 instead of "

@@ -74,8 +74,10 @@ class ComputeConfig:
     # a real sentinel, so drivers can tell an explicit choice from an
     # unset field.
     metric: str | None = None
-    # braycurtis lowering: "exact" (VPU elementwise) or "matmul"
-    # (threshold-decomposed MXU path, quantised to `braycurtis_levels`).
+    # braycurtis lowering: "exact" (VPU elementwise), "matmul"
+    # (threshold-decomposed MXU path, quantised to `braycurtis_levels`),
+    # or "pallas" (fused VMEM kernel — ops/pallas; exact like "exact",
+    # interpreted when the backend is CPU so tests stay hardware-free).
     braycurtis_method: str = "exact"
     braycurtis_levels: int = 256
     num_pc: int = 10

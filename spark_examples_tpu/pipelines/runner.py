@@ -255,16 +255,32 @@ def _run_braycurtis(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResu
     with timer.phase("ingest"):
         x = _materialize(source, job.ingest.block_variants)
         x = np.maximum(x, 0)  # missing (-1) counts as absence
+    method = job.compute.braycurtis_method
+    if method not in ("exact", "matmul", "pallas"):
+        raise ValueError(
+            f"unknown braycurtis_method {method!r}; "
+            "valid: exact | matmul | pallas"
+        )
     if job.compute.backend == "cpu-reference":
         with timer.phase("distance"):
             d = oracle.cpu_braycurtis(x)
-    elif job.compute.braycurtis_method == "matmul":
+    elif method == "matmul":
         with timer.phase("distance"):
             d = np.asarray(
                 distances.braycurtis_matmul(
                     x, levels=job.compute.braycurtis_levels
                 )
             )
+    elif method == "pallas":
+        from spark_examples_tpu.ops.pallas.braycurtis_kernel import (
+            braycurtis_pallas,
+        )
+
+        # Mosaic compiles only for TPU; on the CPU backend (tests,
+        # local[*] analogue) run the same kernel under the interpreter.
+        interpret = jax.default_backend() == "cpu"
+        with timer.phase("distance"):
+            d = np.asarray(braycurtis_pallas(x, interpret=interpret))
     else:
         with timer.phase("distance"):
             d = np.asarray(distances.braycurtis(x))

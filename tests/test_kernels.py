@@ -72,3 +72,48 @@ def test_braycurtis_matmul_pipeline_option(rng):
     )
     want = oracle.cpu_braycurtis(x.astype(np.float64))
     np.testing.assert_allclose(res.distance, want, rtol=1e-2, atol=1e-3)
+
+
+def test_braycurtis_pallas_pipeline_option(rng):
+    """`braycurtis_method="pallas"` is user-reachable end-to-end; on the
+    CPU test backend the runner auto-selects interpret mode."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig,
+        IngestConfig,
+        JobConfig,
+    )
+    from spark_examples_tpu.ingest import ArraySource
+    from spark_examples_tpu.pipelines import runner
+
+    x = np.abs(rng.integers(0, 3, (20, 256), dtype=np.int8))
+    res = runner.run_similarity(
+        JobConfig(
+            ingest=IngestConfig(block_variants=64),
+            compute=ComputeConfig(metric="braycurtis",
+                                  braycurtis_method="pallas"),
+        ),
+        source=ArraySource(x.astype(np.int8)),
+    )
+    want = oracle.cpu_braycurtis(x.astype(np.float64))
+    np.testing.assert_allclose(res.distance, want, rtol=1e-4, atol=1e-5)
+
+
+def test_braycurtis_unknown_method_rejected(rng):
+    from spark_examples_tpu.core.config import (
+        ComputeConfig,
+        IngestConfig,
+        JobConfig,
+    )
+    from spark_examples_tpu.ingest import ArraySource
+    from spark_examples_tpu.pipelines import runner
+
+    x = np.abs(rng.integers(0, 3, (8, 64), dtype=np.int8))
+    with pytest.raises(ValueError, match="braycurtis_method"):
+        runner.run_similarity(
+            JobConfig(
+                ingest=IngestConfig(block_variants=64),
+                compute=ComputeConfig(metric="braycurtis",
+                                      braycurtis_method="fused"),
+            ),
+            source=ArraySource(x),
+        )
