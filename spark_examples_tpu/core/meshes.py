@@ -40,17 +40,27 @@ def maybe_init_distributed() -> None:
     """Initialise ``jax.distributed`` when launched multi-host.
 
     Single-host runs (this environment) skip it; multi-host launchers set
-    ``JAX_COORDINATOR_ADDRESS`` (plus process id/count env vars). Must run
-    before any JAX backend is touched — so this deliberately avoids
-    querying ``jax.process_count()``/``jax.devices()`` first. Mirrors the
-    role of the reference's SparkContext connect (SURVEY.md §3.1) minus
-    the driver/executor split.
+    ``JAX_COORDINATOR_ADDRESS`` plus — outside of auto-detected cluster
+    environments (Slurm/OMPI/GKE, which JAX sniffs itself) —
+    ``JAX_NUM_PROCESSES`` and ``JAX_PROCESS_ID``, so a plain
+    two-terminal/ssh launch works without a cluster manager (exercised by
+    ``tests/test_distributed.py`` with two localhost processes over the
+    DCN-analogue gRPC coordinator). Must run before any JAX backend is
+    touched — so this deliberately avoids querying
+    ``jax.process_count()``/``jax.devices()`` first. Mirrors the role of
+    the reference's SparkContext connect (SURVEY.md §3.1) minus the
+    driver/executor split.
     """
     global _distributed_initialized
     if _distributed_initialized:
         return
     if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        jax.distributed.initialize()
+        kw = {}
+        if os.environ.get("JAX_NUM_PROCESSES"):
+            kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+        if os.environ.get("JAX_PROCESS_ID"):
+            kw["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+        jax.distributed.initialize(**kw)
     _distributed_initialized = True
 
 

@@ -1,0 +1,123 @@
+"""Multi-process (DCN-analogue) test: two `jax.distributed` processes on
+localhost run a variant-mode gram update together.
+
+The reference's multi-node story was Spark executors coordinating over
+netty; the rebuild's is `jax.distributed` (gRPC coordinator = the DCN
+control plane) + XLA collectives across process-spanning meshes
+(SURVEY.md §2.2 "Distributed communication backend"). The in-process
+virtual-CPU mesh (conftest) cannot exercise that coordinator path, so
+this test launches two real OS processes, each owning 2 virtual CPU
+devices of a shared 4-device mesh, streams each process its half of the
+variant axis, and checks the psum-merged accumulator matches the
+single-process oracle bit-for-bit.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import json, os, sys
+import numpy as np
+
+# Env vars alone lose to this image's sitecustomize (which registers the
+# axon TPU plugin at interpreter startup); the jax.config update inside
+# force_virtual_cpu is what actually pins the CPU backend — same
+# bootstrap as tests/conftest.py, but per-process here.
+from spark_examples_tpu.core.virtual import force_virtual_cpu
+force_virtual_cpu(2)
+
+import jax
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.ops import gram as gram_ops
+from spark_examples_tpu.parallel import gram_sharded
+
+meshes.maybe_init_distributed()  # the code path under test
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, jax.devices()
+
+N, V = 24, 64
+METRIC = "ibs"
+
+mesh = meshes.make_mesh()  # global (2, 2) over both processes
+plan = gram_sharded.plan_for(mesh, N, METRIC, "variant")
+update = gram_sharded.make_update(plan, METRIC, packed=False)
+
+# Same seeded cohort in both processes (the driver replicates metadata;
+# the data plane is sharded by the block_sharding placement below).
+rng = np.random.default_rng(99)
+g = rng.integers(0, 3, size=(N, V), dtype=np.int8)
+g[rng.random((N, V)) < 0.15] = -1
+
+acc = jax.jit(
+    lambda: gram_ops.init(N, METRIC),
+    out_shardings={
+        k: plan.acc_sharding for k in gram_ops.PIECES_FOR_METRIC[METRIC]
+    },
+)()
+
+# Two blocks, each device_put across the process-spanning mesh: each
+# process materialises only its addressable variant shards.
+for blk in (g[:, : V // 2], g[:, V // 2 :]):
+    block = jax.make_array_from_callback(
+        blk.shape, plan.block_sharding, lambda idx, b=blk: b[idx]
+    )
+    acc = update(acc, block)
+
+# Variant mode replicates the accumulator: every process holds the full
+# psum-merged matrix in each addressable shard.
+got = {k: np.asarray(v.addressable_data(0)) for k, v in acc.items()}
+from spark_examples_tpu.utils import oracle
+want = oracle.cpu_gram_products(g, gram_ops.PIECES_FOR_METRIC[METRIC])
+err = max(
+    float(np.abs(got[k] - np.asarray(want[k], np.int64)).max()) for k in got
+)
+print(json.dumps({"process": jax.process_index(), "max_err": err}))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_variant_gram():
+    port = _free_port()
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER], env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out (coordinator stall)")
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert {o["process"] for o in outs} == {0, 1}
+    assert all(o["max_err"] == 0.0 for o in outs), outs
