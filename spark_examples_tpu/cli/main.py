@@ -41,6 +41,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     g.add_argument("--seed", type=int, default=0)
     g.add_argument("--block-variants", type=int, default=8192,
                    help="variants per streamed block (the partition size)")
+    g.add_argument("--splits-per-contig", type=int, default=1,
+                   help="split each --references range into N sub-ranges "
+                   "read concurrently (the reference partitioner's "
+                   "FixedContigSplits); 1 disables")
+    g.add_argument("--ingest-workers", type=int, default=4,
+                   help="concurrent range readers for --splits-per-contig")
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
@@ -68,6 +74,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--output-path", default=None)
     p.add_argument("--timings", action="store_true",
                    help="print per-phase timing JSON to stderr")
+    p.add_argument("--trace-dir", default=None,
+                   help="capture a jax.profiler trace of the job into this "
+                   "directory (view with tensorboard's profile plugin)")
 
 
 def _job_from_args(args) -> JobConfig:
@@ -85,6 +94,8 @@ def _job_from_args(args) -> JobConfig:
             n_populations=args.n_populations,
             block_variants=args.block_variants,
             seed=args.seed,
+            splits_per_contig=args.splits_per_contig,
+            ingest_workers=args.ingest_workers,
         ),
         compute=ComputeConfig(
             backend=args.backend,
@@ -182,9 +193,21 @@ def main(argv: list[str] | None = None) -> int:
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    import contextlib
+
+    from spark_examples_tpu.core import profiling
     from spark_examples_tpu.pipelines import jobs as J
     from spark_examples_tpu.pipelines.runner import build_source
 
+    # --trace-dir wraps the whole job in a jax.profiler capture (the
+    # Spark-web-UI replacement, SURVEY.md §5); exit stack so every
+    # command path below stops the trace on its way out.
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(profiling.trace(getattr(args, "trace_dir", None)))
+        return _dispatch(args, parser, job, J, build_source)
+
+
+def _dispatch(args, parser, job, J, build_source) -> int:
     if args.command == "similarity":
         res = J.similarity_matrix_job(job)
         print(

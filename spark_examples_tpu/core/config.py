@@ -59,6 +59,12 @@ class IngestConfig:
     block_variants: int = 8192  # variants per streamed block (v_blk)
     seed: int = 0  # synthetic source seed
     n_populations: int = 5  # synthetic ancestry clusters
+    # Partitioned ingest (the reference's FixedContigSplits(n)): split
+    # each --references range into this many sub-ranges and read them
+    # with `ingest_workers` concurrent reader threads (order-preserving
+    # — the emitted stream is identical to the sequential one). 1 = off.
+    splits_per_contig: int = 1
+    ingest_workers: int = 4
 
 
 @dataclass

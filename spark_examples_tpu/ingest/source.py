@@ -163,7 +163,11 @@ class ChainSource:
                 offset += pv
                 continue
             local_start = max(0, start_variant - offset)
-            # Align local start down to the part's own block grid.
+            # local_start is passed through verbatim: parts ceil-align a
+            # mid-block cursor to the next block boundary (ArraySource)
+            # or treat it as an exact record ordinal (VcfSource) — both
+            # are correct for cursors this same geometry produced, which
+            # is the only kind checkpoint/resume ever feeds in.
             for block, meta in part.blocks(block_variants, local_start):
                 yield block, dataclasses.replace(
                     meta,

@@ -56,6 +56,23 @@ def build_source(cfg: IngestConfig):
     if cfg.source == "vcf":
         if not cfg.path:
             raise ValueError("vcf source requires ingest.path")
+        if cfg.splits_per_contig > 1 and cfg.references:
+            # The reference's FixedContigSplits(n): one reader per
+            # sub-range, read concurrently, consumed in range order
+            # (identical stream for position-sorted non-overlapping
+            # ranges — the partitioner's own precondition).
+            from spark_examples_tpu.ingest.partitioned import (
+                PartitionedSource,
+            )
+            from spark_examples_tpu.ingest.source import partition_ranges
+
+            parts = [
+                VcfSource(cfg.path, references=(r,))
+                for r in partition_ranges(
+                    cfg.references, cfg.splits_per_contig
+                )
+            ]
+            return PartitionedSource(parts, max_workers=cfg.ingest_workers)
         return VcfSource(cfg.path, references=tuple(cfg.references))
     if cfg.source == "packed":
         if not cfg.path:

@@ -84,3 +84,16 @@ def test_cli_vcf_source(tmp_path, capsys):
     cap = _run(capsys, "search-variants", "--source", "vcf", "--path", path,
                "--positions", "100")
     assert cap.out.startswith("chr22:100")
+
+
+def test_cli_trace_dir_captures_profile(tmp_path, capsys):
+    trace_dir = str(tmp_path / "trace")
+    _run(capsys, "pcoa", *BASE, "--num-pc", "2", "--trace-dir", trace_dir)
+    import os
+
+    found = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(trace_dir)
+        for f in fs
+    ]
+    assert found, "no jax.profiler trace files written"
