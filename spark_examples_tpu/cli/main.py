@@ -77,6 +77,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler trace of the job into this "
                    "directory (view with tensorboard's profile plugin)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans: fail loudly at the first "
+                   "NaN-producing op instead of emitting NaN coordinates "
+                   "(numeric sanitizer, SURVEY.md §5; slows compute)")
 
 
 def _job_from_args(args) -> JobConfig:
@@ -192,6 +196,8 @@ def main(argv: list[str] | None = None) -> int:
         ),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    if getattr(args, "debug_nans", False):
+        jax.config.update("jax_debug_nans", True)
 
     import contextlib
 

@@ -53,23 +53,33 @@ def genotype_histogram(
     positions: set[int] | None = None,
 ) -> list[VariantCounts]:
     """Genotype histograms per variant, optionally restricted to a set of
-    genomic positions (the Klotho/BRCA1 'search' shape)."""
+    genomic positions (the Klotho/BRCA1 'search' shape).
+
+    Per block the work is one jitted reduction plus vectorized position
+    matching — a filtered search touches no per-variant Python at all on
+    blocks with no hits, and a full scan builds its result rows from one
+    ``tolist()`` per block rather than per-element array indexing."""
     out: list[VariantCounts] = []
+    pos_arr = (
+        np.fromiter(positions, dtype=np.int64) if positions else None
+    )
     for block, meta in source.blocks(block_variants):
-        hist = None
-        for j in range(block.shape[1]):
-            pos = (
-                int(meta.positions[j])
-                if meta.positions is not None
-                else meta.start + j
-            )
-            if positions is not None and pos not in positions:
-                continue
-            if hist is None:
-                hist = np.asarray(_block_histogram(block))
-            h = hist[j]
-            out.append(
-                VariantCounts(meta.contig, pos, int(h[0]), int(h[1]),
-                              int(h[2]), int(h[3]))
-            )
+        blk_pos = (
+            np.asarray(meta.positions, dtype=np.int64)
+            if meta.positions is not None
+            else np.arange(meta.start, meta.stop, dtype=np.int64)
+        )
+        if pos_arr is not None:
+            keep = np.nonzero(np.isin(blk_pos, pos_arr))[0]
+            if keep.size == 0:
+                continue  # no matches: skip the reduction entirely
+        else:
+            keep = None
+        hist = np.asarray(_block_histogram(block))
+        if keep is not None:
+            hist, blk_pos = hist[keep], blk_pos[keep]
+        out.extend(
+            VariantCounts(meta.contig, int(p), h0, h1, h2, hm)
+            for p, (h0, h1, h2, hm) in zip(blk_pos, hist.tolist())
+        )
     return out

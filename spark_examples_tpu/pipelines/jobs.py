@@ -80,7 +80,7 @@ def pcoa_job(
                 from spark_examples_tpu.pipelines.runner import build_source
 
                 source = build_source(job.ingest)
-        routed = _pcoa_sharded_route(job, source, timer)
+        routed = _pcoa_device_route(job, source, timer)
         if routed is not None:
             return routed
         sim = run_similarity(job, source=source)
@@ -120,29 +120,50 @@ def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
     return out
 
 
-def _pcoa_sharded_route(job: JobConfig, source, timer) -> CoordsOutput | None:
-    """The config-4 (76k-exome) route: when the plan tiles the N x N
-    accumulator over the mesh, keep EVERYTHING sharded — finalize,
-    centering, and the randomized eigensolve — so no device (or the
-    host) ever materializes the full matrix. Returns None when the job
-    runs one of the dense routes instead (caller reuses ``source``)."""
-    from spark_examples_tpu.pipelines import runner
+def _pcoa_device_route(job: JobConfig, source, timer) -> CoordsOutput | None:
+    """Device-resident streamed PCoA: gram accumulators -> finalize ->
+    center -> eigh -> coords without the N x N matrix ever touching the
+    host (only the (N, k) coordinates come back). Two variants by plan:
+
+    - tile2d (the config-4 / 76k-exome regime): everything stays
+      tile-sharded via parallel.pcoa_sharded — no single *device* holds
+      the full matrix either;
+    - replicated/variant: the matrix is device-dense, but still skips
+      run_similarity's host materialization (similarity + distance D2H
+      plus the eigh re-upload — ~75 MB of round-trip at N=2504 that a
+      slow host link turns into many seconds of dead time).
+
+    Returns None when the job needs a dense host route instead
+    (cpu-reference backend, braycurtis's table path, dense eigh on a
+    tiled plan); the caller falls back to run_similarity.
+    """
+    from spark_examples_tpu.models.pcoa import fit_pcoa
     from spark_examples_tpu.parallel.pcoa_sharded import pcoa_coords_sharded
+    from spark_examples_tpu.pipelines import runner
 
     cfg = job.compute
     metric = cfg.metric or "ibs"
     if cfg.backend == "cpu-reference" or metric == "braycurtis":
         return None
-    if cfg.eigh_mode == "dense":
-        return None  # dense eigh requires the materialized matrix
     plan = runner.plan_for_job(job, source)
-    if plan.mode != "tile2d":
-        return None
+    if plan.mode == "tile2d" and cfg.eigh_mode == "dense":
+        return None  # dense eigh requires the materialized matrix
     grun = runner.run_gram(job, source, timer, plan=plan)
-    res = pcoa_coords_sharded(plan, grun.acc, metric, k=cfg.num_pc,
-                              timer=timer)
-    return _emit_coords(job, grun.sample_ids, res.coords, res.eigenvalues,
-                        timer, grun.n_variants, method="randomized")
+    if plan.mode == "tile2d":
+        res = pcoa_coords_sharded(plan, grun.acc, metric, k=cfg.num_pc,
+                                  timer=timer)
+        method = "randomized"
+    else:
+        with timer.phase("finalize"):
+            dist = hard_sync(
+                runner.finalize_field(grun.acc, metric, "distance")
+            )
+        method = _eigh_method(cfg.eigh_mode, dist.shape[0])
+        with timer.phase("eigh"):
+            res = hard_sync(fit_pcoa(dist, k=cfg.num_pc, method=method))
+    return _emit_coords(job, grun.sample_ids, np.asarray(res.coords),
+                        np.asarray(res.eigenvalues), timer,
+                        grun.n_variants, method=method)
 
 
 def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
@@ -166,8 +187,39 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
     job = job.replace(
         compute=dataclasses.replace(job.compute, metric="shared-alt")
     )
-    sim = run_similarity(job, source=source)
     k = job.compute.num_pc
+
+    if job.compute.backend != "cpu-reference":
+        # Device-resident route: similarity never leaves the chip; only
+        # the (N, k) projections come home (see _pcoa_device_route).
+        from spark_examples_tpu.pipelines import runner
+
+        timer = PhaseTimer()
+        if source is None:
+            with timer.phase("ingest_setup"):
+                from spark_examples_tpu.pipelines.runner import build_source
+
+                source = build_source(job.ingest)
+        plan = runner.plan_for_job(job, source)
+        if plan.mode != "tile2d":  # dense eigh needs the full matrix
+            grun = runner.run_gram(job, source, timer, plan=plan)
+            with timer.phase("finalize"):
+                sim_dev = hard_sync(
+                    runner.finalize_field(grun.acc, "shared-alt",
+                                          "similarity")
+                )
+            with timer.phase("eigh"):
+                res = hard_sync(fit_pca(sim_dev, k=k))
+            timer.add("eigh_flops", eigh_flops(len(grun.sample_ids)))
+            out = CoordsOutput(grun.sample_ids, np.asarray(res.coords),
+                               np.asarray(res.eigenvalues), timer,
+                               grun.n_variants)
+            if job.output_path:
+                pio.write_coords_tsv(job.output_path, out.sample_ids,
+                                     out.coords)
+            return out
+
+    sim = run_similarity(job, source=source)
     if job.compute.backend == "cpu-reference":
         with sim.timer.phase("eigh"):
             coords, vals = oracle.pca_mllib_route(

@@ -19,6 +19,7 @@ measured denominator of BASELINE.md.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import numpy as np
@@ -42,6 +43,18 @@ from spark_examples_tpu.utils import oracle
 # finalize is cheap math over N x N pieces, but run eagerly it dispatches
 # one tunnel round-trip per op — jit it once per metric.
 _finalize_jit = jax.jit(distances.finalize, static_argnames=("metric",))
+
+
+@partial(jax.jit, static_argnames=("metric", "field"))
+def finalize_field(acc, metric: str, field: str):
+    """One finalized matrix ("similarity" or "distance"), left on device.
+
+    The device-resident job routes (pcoa/pca) consume exactly one of the
+    two finalize outputs and never materialize it on the host — at
+    N=2504 the full pair is ~50 MB, a multi-second D2H round-trip on a
+    slow host link that run_similarity pays only because a persisted
+    matrix is that job's actual output."""
+    return distances.finalize(acc, metric)[field]
 
 
 def build_source(cfg: IngestConfig):
