@@ -38,10 +38,19 @@ def pack_dosages(g: np.ndarray) -> np.ndarray:
     2-bit truncation, so they are rejected loudly — the packed path is for
     genotype dosages (core/dtypes.py policy), not arbitrary count tables
     (those take the dense Bray-Curtis route).
+
+    Runs in the prefetch producer thread, so it takes the single-pass
+    native loop (native/codec.cpp) when available; the NumPy path below
+    is the byte-identical fallback and test oracle.
     """
     g = np.asarray(g)
     if g.ndim != 2:
         raise ValueError(f"expected (N, V) matrix, got shape {g.shape}")
+    from spark_examples_tpu import native
+
+    out = native.pack_dosages(g)
+    if out is not None:
+        return out
     lo, hi = int(g.min(initial=0)), int(g.max(initial=0))
     if lo < -1 or hi > 2:
         raise ValueError(
@@ -68,6 +77,11 @@ def unpack_dosages_np(packed: np.ndarray) -> np.ndarray:
     missing (-1), which downstream accumulation treats as absent.
     """
     packed = np.asarray(packed, np.uint8)
+    from spark_examples_tpu import native
+
+    out = native.unpack_dosages(packed)
+    if out is not None:
+        return out
     shifts = np.array([0, 2, 4, 6], np.uint8)
     codes = (packed[:, :, None] >> shifts) & np.uint8(3)
     codes = codes.reshape(packed.shape[0], -1)

@@ -31,6 +31,15 @@ def _open(path: str):
     return open(path, "rt")
 
 
+def _open_bytes(path: str):
+    """Binary record stream — the parse loop stays on bytes so the native
+    GT parser (native/codec.cpp vcf_parse_gt) sees the raw line with no
+    per-line decode/encode round-trip."""
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
 def _dosage(gt: str) -> int:
     """GT string -> dosage in {-1, 0, 1, 2}."""
     # strip trailing FORMAT subfields if caller passed the whole sample col
@@ -89,23 +98,75 @@ class VcfSource:
                 return True
         return False
 
-    def _records(self) -> Iterator[tuple[str, int, list[str]]]:
-        """Yield (contig, pos, per-sample GT strings)."""
-        with _open(self.path) as f:
+    def _records(self) -> Iterator[tuple[str, int, np.ndarray]]:
+        """Yield (contig, pos, int8 dosage column).
+
+        Splits only the 9 fixed VCF columns in Python; the per-sample GT
+        parse — the loop that runs N times per record — goes through the
+        native parser when available, with a GT-string-cached Python
+        fallback carrying identical semantics (pinned by tests under
+        SPARK_TPU_NO_NATIVE=1).
+        """
+        from spark_examples_tpu import native
+
+        n = self.n_samples
+        use_native = native.load() is not None
+        gt_cache: dict[bytes, int] = {}
+        short_records = 0
+        with _open_bytes(self.path) as f:
             for line in f:
-                if line.startswith("#"):
+                if line.startswith(b"#"):
                     continue
-                fields = line.rstrip("\n").split("\t")
-                contig, pos = fields[0], int(fields[1])
+                # \r too: binary reads see CRLF files raw (text mode's
+                # universal newlines used to hide this), and a trailing
+                # \r would corrupt the last sample's GT.
+                line = line.rstrip(b"\r\n")
+                prefix = line.split(b"\t", 9)
+                if len(prefix) < 10:
+                    continue
+                contig, pos = prefix[0].decode(), int(prefix[1])
                 if not self._in_range(contig, pos):
                     continue
-                fmt = fields[8].split(":")
+                fmt = prefix[8].split(b":")
                 try:
-                    gt_idx = fmt.index("GT")
+                    gt_idx = fmt.index(b"GT")
                 except ValueError:
                     continue  # no genotypes at this site
-                gts = [s.split(":")[gt_idx] for s in fields[9:]]
-                yield contig, pos, gts
+                col = np.empty(n, dtype=np.int8)
+                if use_native and native.vcf_parse_gt(line, gt_idx, n, col):
+                    yield contig, pos, col
+                    continue
+                gts = prefix[9].split(b"\t")
+                if len(gts) < n:
+                    # Truncated/malformed record (interrupted download,
+                    # mid-line cut). Skipping silently would present a
+                    # clean job computed on reduced data — warn loudly,
+                    # once per stream.
+                    short_records += 1
+                    if short_records == 1:
+                        import warnings
+
+                        warnings.warn(
+                            f"{self.path}: record at {contig}:{pos} has "
+                            f"{len(gts)} sample columns, expected {n} — "
+                            "skipping record(s); the file may be "
+                            "truncated or malformed",
+                            RuntimeWarning,
+                            stacklevel=3,
+                        )
+                    continue
+                for i in range(n):
+                    # VCF permits dropping trailing subfields, so a short
+                    # sample column means GT is absent -> missing (the
+                    # native parser's 'missing subfield' branch).
+                    sub = gts[i].split(b":")
+                    gt = sub[gt_idx] if gt_idx < len(sub) else b""
+                    d = gt_cache.get(gt)
+                    if d is None:
+                        d = _dosage(gt.decode())
+                        gt_cache[gt] = d
+                    col[i] = d
+                yield contig, pos, col
 
     def blocks(self, block_variants: int, start_variant: int = 0):
         """Stream (N, <=block_variants) blocks.
@@ -116,14 +177,12 @@ class VcfSource:
         plain record ordinal — any ``start_variant`` a previous stream's
         ``meta.stop`` produced is valid, aligned or not.
         """
-        n = self.n_samples
         cols: list[np.ndarray] = []
         positions: list[int] = []
         cur_contig: str | None = None
         idx = 0
         emitted_start = start_variant
         seen = 0
-        gt_cache: dict[str, int] = {}
 
         def flush():
             nonlocal cols, positions, idx, emitted_start
@@ -142,7 +201,7 @@ class VcfSource:
             cols, positions = [], []
             return block
 
-        for contig, pos, gts in self._records():
+        for contig, pos, col in self._records():
             if seen < start_variant:
                 seen += 1
                 continue
@@ -150,13 +209,6 @@ class VcfSource:
             if cols and (len(cols) == block_variants or contig != cur_contig):
                 yield flush()
             cur_contig = contig
-            col = np.empty(n, dtype=np.int8)
-            for i, gt in enumerate(gts):
-                d = gt_cache.get(gt)
-                if d is None:
-                    d = _dosage(gt)
-                    gt_cache[gt] = d
-                col[i] = d
             cols.append(col)
             positions.append(pos)
         if cols:

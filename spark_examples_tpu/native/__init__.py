@@ -1,0 +1,176 @@
+"""Native host-side codec: lazy-built C++ shared library (ctypes).
+
+The compute path is JAX/XLA/Pallas on the chip; the *runtime around it*
+— here, the data-loader inner loops that feed the prefetch queue — is
+native C++ (SURVEY.md §2 note: the reference's only "native" code lived
+in external JVM deps; the rebuild's loader is its honest successor).
+
+Loading policy: build ``libsparktpu.so`` from ``codec.cpp`` with g++ on
+first use (cached beside the source, rebuilt when the source is newer),
+and fall back to the pure-NumPy/Python implementations on any failure —
+the library is an accelerator, never a semantic fork. Set
+``SPARK_TPU_NO_NATIVE=1`` to force the fallback (tests use this to pin
+native == Python byte-for-byte).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "codec.cpp")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _host_tag() -> str:
+    """Cache key: host-ISA fingerprint + source content hash.
+
+    - ISA half: the resolved ``-march=native`` target flags, so a
+      library built on a wider-ISA machine is never loaded on a narrower
+      one (shared/NFS package dirs) — a foreign-ISA .so would pass CDLL
+      and then SIGILL mid-call, which no Python-level fallback can
+      catch. A host with a different CPU resolves a different tag and
+      rebuilds its own copy.
+    - Source half: a hash of codec.cpp itself, so a cached build from an
+      older package version can never load against newer ctypes wrappers
+      (mtime comparisons lie under pip/sdist timestamp normalization).
+    """
+    with open(_SRC, "rb") as f:
+        src = hashlib.sha1(f.read()).hexdigest()[:8]
+    try:
+        out = subprocess.run(
+            ["g++", "-march=native", "-Q", "--help=target"],
+            capture_output=True, timeout=30,
+        ).stdout
+        isa = hashlib.sha1(out).hexdigest()[:12]
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return f"portable-{src}"
+    return f"{isa}-{src}"
+
+
+def _lib_path(tag: str) -> str:
+    """Cache location for the built .so: beside the source when the
+    package dir is writable (dev checkouts), else a per-user cache dir —
+    a root-owned site-packages install must not doom every process to a
+    failing compile attempt."""
+    if os.access(_DIR, os.W_OK):
+        return os.path.join(_DIR, f"libsparktpu-{tag}.so")
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    d = os.path.join(base, "spark-examples-tpu")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"libsparktpu-{tag}.so")
+
+
+def _build(lib_path: str, march_native: bool) -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o"]
+    if march_native:
+        cmd.insert(1, "-march=native")
+    # Unique temp per process: concurrent builders (two-process
+    # jax.distributed launches, pytest-xdist) must not scribble into a
+    # path another process just os.replace()d live.
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(cmd + [tmp], check=True, capture_output=True,
+                       timeout=120)
+        os.replace(tmp, lib_path)  # atomic publish
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The shared library, building it if needed; None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SPARK_TPU_NO_NATIVE"):
+            return None
+        try:
+            tag = _host_tag()
+            path = _lib_path(tag)
+            # The tag embeds a source-content hash, so existence IS
+            # freshness — no mtime comparison (archive-normalized
+            # timestamps make those lie).
+            if not os.path.exists(path) and not _build(
+                path, march_native=not tag.startswith("portable")
+            ):
+                return None
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        i64, i8p, u8p, cp = (
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ctypes.c_char_p,
+        )
+        lib.pack_dosages_i8.argtypes = [i8p, i64, i64, u8p]
+        lib.pack_dosages_i8.restype = ctypes.c_int
+        lib.unpack_dosages_u8.argtypes = [u8p, i64, i64, i8p]
+        lib.unpack_dosages_u8.restype = None
+        lib.vcf_parse_gt.argtypes = [cp, i64, i64, i64, i8p, i64]
+        lib.vcf_parse_gt.restype = i64
+        _lib = lib
+        return _lib
+
+
+def pack_dosages(g: np.ndarray) -> np.ndarray | None:
+    """Native 2-bit pack; None when the library is unavailable (caller
+    falls back to NumPy). Raises on out-of-domain values, matching the
+    NumPy path's loud rejection."""
+    lib = load()
+    if lib is None or g.dtype != np.int8:
+        return None  # other dtypes would wrap under the int8 view;
+        # the NumPy fallback validates the wide domain itself
+    g = np.ascontiguousarray(g)
+    n, v = g.shape
+    out = np.empty((n, -(-v // 4)), np.uint8)
+    if lib.pack_dosages_i8(g, n, v, out):
+        raise ValueError(
+            "dosage values out of 2-bit range [-1, 2] "
+            "(pack_dosages is for genotype dosages only)"
+        )
+    return out
+
+
+def unpack_dosages(packed: np.ndarray) -> np.ndarray | None:
+    """Native host-side 2-bit unpack; None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    packed = np.ascontiguousarray(packed, np.uint8)
+    n, w = packed.shape
+    out = np.empty((n, 4 * w), np.int8)
+    lib.unpack_dosages_u8(packed, n, w, out)
+    return out
+
+
+def vcf_parse_gt(line: bytes, gt_index: int, n_samples: int,
+                 out: np.ndarray) -> bool:
+    """Parse one VCF record's sample GT columns into ``out`` (int8,
+    n_samples). Returns False when the library is unavailable or the
+    record is short (caller falls back to the Python parser)."""
+    lib = load()
+    if lib is None:
+        return False
+    got = lib.vcf_parse_gt(line, len(line), 9, gt_index, out, n_samples)
+    return got == n_samples

@@ -1,0 +1,138 @@
+// Native host-side data-loader kernels (C ABI, loaded via ctypes).
+//
+// The reference ran its ingest inner loops on the JVM (Genomics API JSON
+// paging + case-class conversion, SURVEY.md §3.5); this framework's
+// equivalent hot loops are host-side and feed the TPU's prefetch queue:
+//
+//   * 2-bit dosage packing   (ingest/bitpack.py pack_dosages)
+//   * 2-bit unpack, host side (CPU oracle / cpu-reference backend)
+//   * VCF GT-column parsing  (ingest/vcf.py _dosage / _records)
+//
+// They run in the producer thread, so every cycle spent here is a cycle
+// the queue is not being filled. The NumPy implementations allocate
+// several full-size temporaries per block (where/astype/concat plus a
+// shift-or tree); these single-pass loops exist to keep the producer
+// ahead of the chip. Python keeps byte-identical fallbacks — the
+// library is an accelerator, never a semantic fork (tests pin native ==
+// NumPy on the same inputs).
+//
+// Build: g++ -O3 -march=native -shared -fPIC codec.cpp -o libsparktpu.so
+// (spark_examples_tpu/native/__init__.py builds lazily and caches).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// (n, v) int8 dosages {-1,0,1,2} -> (n, ceil(v/4)) uint8, 4 codes/byte.
+// code 3 = missing; pad columns (v % 4) are filled with code 3, which
+// downstream accumulation treats as absent. Returns 0, or 1 if any
+// value falls outside [-1, 2] (caller raises — silent truncation would
+// corrupt counts).
+int pack_dosages_i8(const int8_t* g, int64_t n, int64_t v, uint8_t* out) {
+    const int64_t w = (v + 3) / 4;           // packed bytes per row
+    const int64_t v4 = v / 4 * 4;            // full-byte prefix
+    int bad = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const int8_t* row = g + i * v;
+        uint8_t* orow = out + i * w;
+        int64_t j = 0;
+        for (; j < v4; j += 4) {
+            uint8_t b = 0;
+            for (int k = 0; k < 4; ++k) {
+                int8_t x = row[j + k];
+                bad |= (x < -1) | (x > 2);
+                uint8_t code = (x < 0) ? 3u : (uint8_t)x;
+                b |= code << (2 * k);
+            }
+            orow[j >> 2] = b;
+        }
+        if (j < v) {                          // ragged tail byte
+            uint8_t b = 0;
+            for (int k = 0; k < 4; ++k) {
+                uint8_t code = 3u;            // pad = missing
+                if (j + k < v) {
+                    int8_t x = row[j + k];
+                    bad |= (x < -1) | (x > 2);
+                    code = (x < 0) ? 3u : (uint8_t)x;
+                }
+                b |= code << (2 * k);
+            }
+            orow[j >> 2] = b;
+        }
+    }
+    return bad;
+}
+
+// (n, w) packed uint8 -> (n, 4*w) int8 dosages; code 3 -> -1.
+void unpack_dosages_u8(const uint8_t* packed, int64_t n, int64_t w,
+                       int8_t* out) {
+    static const int8_t lut[4] = {0, 1, 2, -1};
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* row = packed + i * w;
+        int8_t* orow = out + i * 4 * w;
+        for (int64_t j = 0; j < w; ++j) {
+            uint8_t b = row[j];
+            orow[4 * j + 0] = lut[b & 3];
+            orow[4 * j + 1] = lut[(b >> 2) & 3];
+            orow[4 * j + 2] = lut[(b >> 4) & 3];
+            orow[4 * j + 3] = lut[(b >> 6) & 3];
+        }
+    }
+}
+
+// One VCF record's sample columns -> int8 dosages.
+//
+// `line` spans the whole tab-separated record (no trailing newline
+// required); parsing starts after `skip_fields` tabs (9 = the fixed VCF
+// columns). Each sample field is split on ':', subfield `gt_index` is
+// the GT string; alleles split on '/' or '|'. Semantics identical to
+// ingest/vcf.py _dosage: any non-"0" called allele adds 1 (capped at
+// 2), "." alleles are skipped, no called allele -> -1 (missing).
+// Returns the number of samples parsed (== n_samples on success), or -1
+// if the record has fewer sample columns than n_samples.
+int64_t vcf_parse_gt(const char* line, int64_t len, int64_t skip_fields,
+                     int64_t gt_index, int8_t* out, int64_t n_samples) {
+    const char* p = line;
+    const char* end = line + len;
+    for (int64_t f = 0; f < skip_fields; ++f) {
+        while (p < end && *p != '\t') ++p;
+        if (p >= end) return -1;
+        ++p;                                  // past the tab
+    }
+    int64_t s = 0;
+    while (s < n_samples) {
+        if (p > end) return -1;
+        const char* fend = p;
+        while (fend < end && *fend != '\t') ++fend;
+        // Select colon-subfield gt_index within [p, fend).
+        const char* g = p;
+        for (int64_t c = 0; c < gt_index; ++c) {
+            while (g < fend && *g != ':') ++g;
+            if (g >= fend) break;             // missing subfield -> empty GT
+            ++g;
+        }
+        const char* gend = g;
+        while (gend < fend && *gend != ':') ++gend;
+        // Parse alleles.
+        int dose = 0, seen = 0;
+        const char* a = g;
+        while (a <= gend) {
+            const char* aend = a;
+            while (aend < gend && *aend != '/' && *aend != '|') ++aend;
+            int64_t alen = aend - a;
+            if (alen > 0 && !(alen == 1 && a[0] == '.')) {
+                seen = 1;
+                if (!(alen == 1 && a[0] == '0')) ++dose;
+            }
+            if (aend >= gend) break;
+            a = aend + 1;
+        }
+        out[s++] = seen ? (int8_t)(dose > 2 ? 2 : dose) : (int8_t)-1;
+        if (fend >= end) break;
+        p = fend + 1;
+    }
+    return s;
+}
+
+}  // extern "C"

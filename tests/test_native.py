@@ -1,0 +1,154 @@
+"""Native C++ codec (native/codec.cpp) == pure-Python semantics.
+
+The shared library is an accelerator for the prefetch producer thread
+and the VCF parse loop, never a semantic fork — these tests pin the
+native outputs byte-for-byte against the NumPy/Python fallbacks on the
+same inputs, including every GT edge case the Python parser defines.
+Skipped wholesale when the library can't build (no g++)."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu import native
+from spark_examples_tpu.ingest import bitpack
+from spark_examples_tpu.ingest.vcf import VcfSource, _dosage, write_vcf
+from tests.conftest import random_genotypes
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native library unavailable (no g++?)"
+)
+
+
+def _py_pack(g):
+    """The NumPy reference path, bypassing the native fast path."""
+    n, v = g.shape
+    codes = np.where(g < 0, 3, g).astype(np.uint8)
+    pad = -v % 4
+    if pad:
+        codes = np.concatenate(
+            [codes, np.full((n, pad), 3, np.uint8)], axis=1
+        )
+    c = codes.reshape(n, -1, 4)
+    return c[..., 0] | (c[..., 1] << 2) | (c[..., 2] << 4) | (c[..., 3] << 6)
+
+
+@pytest.mark.parametrize("v", [1, 3, 4, 7, 64, 257])
+def test_native_pack_matches_numpy(rng, v):
+    g = random_genotypes(rng, n=11, v=v, missing_rate=0.2)
+    got = native.pack_dosages(g)
+    np.testing.assert_array_equal(got, _py_pack(g))
+
+
+def test_native_pack_rejects_out_of_domain():
+    with pytest.raises(ValueError, match="2-bit range"):
+        native.pack_dosages(np.array([[0, 3]], np.int8))
+    with pytest.raises(ValueError, match="2-bit range"):
+        native.pack_dosages(np.array([[-2, 0]], np.int8))
+
+
+def test_native_pack_declines_wide_dtypes():
+    # int32 input must fall back to NumPy (which validates the wide
+    # domain) rather than being reinterpreted as int8.
+    assert native.pack_dosages(np.array([[0, 1]], np.int32)) is None
+
+
+def test_native_unpack_roundtrip(rng):
+    g = random_genotypes(rng, n=9, v=200, missing_rate=0.3)
+    p = bitpack.pack_dosages(g)
+    out = native.unpack_dosages(p)
+    np.testing.assert_array_equal(out[:, :200], g)
+    assert (out[:, 200:] == -1).all()
+
+
+GT_CASES = [
+    b"0/0", b"0/1", b"1/1", b"1/2", b"2/2", b"./.", b".",
+    b"0|1", b"1|1", b"./1", b"1/.", b"0/0/1", b"1/1/1", b"", b"0",
+]
+
+
+def test_native_gt_parse_matches_python():
+    """One synthetic record exercising every GT edge case, with extra
+    FORMAT subfields and GT not in first position."""
+    n = len(GT_CASES)
+    samples = b"\t".join(b"9:" + gt + b":PASS" for gt in GT_CASES)
+    line = (b"chr1\t100\trs1\tA\tC\t.\tPASS\t.\tDP:GT:FT\t" + samples)
+    out = np.empty(n, np.int8)
+    assert native.vcf_parse_gt(line, 1, n, out)
+    want = [_dosage(gt.decode()) for gt in GT_CASES]
+    np.testing.assert_array_equal(out, np.asarray(want, np.int8))
+
+
+def test_gt_subfields_shorter_than_format():
+    """VCF permits dropping trailing subfields: FORMAT DP:GT with a bare
+    '5' sample column means GT absent -> missing, on BOTH parsers."""
+    line = b"chr1\t1\t.\tA\tC\t.\t.\t.\tDP:GT\t5\t7:0/1"
+    out = np.empty(2, np.int8)
+    assert native.vcf_parse_gt(line, 1, 2, out)
+    np.testing.assert_array_equal(out, np.array([-1, 1], np.int8))
+
+
+def test_vcf_crlf_line_endings(rng, tmp_path, monkeypatch):
+    """CRLF files parse identically to LF files on both parsers — binary
+    reads see the \\r that text mode's universal newlines used to hide,
+    and an unstripped \\r would corrupt the last sample's dosage."""
+    g = random_genotypes(rng, n=5, v=40, missing_rate=0.2)
+    lf, crlf = str(tmp_path / "lf.vcf"), str(tmp_path / "crlf.vcf")
+    write_vcf(lf, g)
+    with open(lf, "rb") as f:
+        body = f.read().replace(b"\n", b"\r\n")
+    with open(crlf, "wb") as f:
+        f.write(body)
+    for forced_fallback in (False, True):
+        if forced_fallback:
+            monkeypatch.setattr(native, "_lib", None)
+            monkeypatch.setattr(native, "_tried", True)
+        out = np.concatenate(
+            [b for b, _ in VcfSource(crlf).blocks(16)], axis=1
+        )
+        np.testing.assert_array_equal(out, g)
+
+
+def test_truncated_vcf_warns(rng, tmp_path):
+    """A record with fewer sample columns than the header (truncated
+    file) is skipped with a loud warning, not silently dropped."""
+    g = random_genotypes(rng, n=6, v=10, missing_rate=0.0)
+    path = str(tmp_path / "t.vcf")
+    write_vcf(path, g)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    # cut the last record mid-line (drop 3 sample columns)
+    lines[-1] = "\t".join(lines[-1].split("\t")[:-3])
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.warns(RuntimeWarning, match="truncated or malformed"):
+        out = np.concatenate(
+            [b for b, _ in VcfSource(path).blocks(4)], axis=1
+        )
+    np.testing.assert_array_equal(out, g[:, :9])  # 9 good records kept
+
+
+def test_native_gt_parse_short_record():
+    out = np.empty(5, np.int8)
+    line = b"chr1\t1\t.\tA\tC\t.\t.\t.\tGT\t0/1\t1/1"
+    assert not native.vcf_parse_gt(line, 0, 5, out)
+
+
+def test_vcf_source_native_vs_python_fallback(rng, tmp_path, monkeypatch):
+    """Full VcfSource stream: native parser == Python parser on the same
+    file (the fallback is forced via SPARK_TPU_NO_NATIVE for a fresh
+    subprocess-free comparison by reloading the module state)."""
+    g = random_genotypes(rng, n=13, v=300, missing_rate=0.15)
+    path = str(tmp_path / "c.vcf")
+    write_vcf(path, g)
+
+    native_blocks = np.concatenate(
+        [b for b, _ in VcfSource(path).blocks(77)], axis=1
+    )
+    # Force the Python path without rebuilding: stub the loader.
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    python_blocks = np.concatenate(
+        [b for b, _ in VcfSource(path).blocks(77)], axis=1
+    )
+    np.testing.assert_array_equal(native_blocks, python_blocks)
+    np.testing.assert_array_equal(native_blocks, g)
