@@ -60,9 +60,15 @@ def genotype_histogram(
     blocks with no hits, and a full scan builds its result rows from one
     ``tolist()`` per block rather than per-element array indexing."""
     out: list[VariantCounts] = []
+    # None = no filter (full scan); an EMPTY set matches nothing —
+    # distinct cases, so test identity, not truthiness.
     pos_arr = (
-        np.fromiter(positions, dtype=np.int64) if positions else None
+        np.fromiter(positions, dtype=np.int64)
+        if positions is not None
+        else None
     )
+    if pos_arr is not None and pos_arr.size == 0:
+        return out
     for block, meta in source.blocks(block_variants):
         blk_pos = (
             np.asarray(meta.positions, dtype=np.int64)

@@ -201,7 +201,10 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
 
                 source = build_source(job.ingest)
         plan = runner.plan_for_job(job, source)
-        if plan.mode != "tile2d":  # dense eigh needs the full matrix
+        # PCA's centered-similarity eig is dense (fit_pca), which needs
+        # the full matrix on one device — tile2d-sharded plans fall back
+        # to the host route below.
+        if plan.mode != "tile2d":
             grun = runner.run_gram(job, source, timer, plan=plan)
             with timer.phase("finalize"):
                 sim_dev = hard_sync(
@@ -210,14 +213,10 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
                 )
             with timer.phase("eigh"):
                 res = hard_sync(fit_pca(sim_dev, k=k))
-            timer.add("eigh_flops", eigh_flops(len(grun.sample_ids)))
-            out = CoordsOutput(grun.sample_ids, np.asarray(res.coords),
-                               np.asarray(res.eigenvalues), timer,
-                               grun.n_variants)
-            if job.output_path:
-                pio.write_coords_tsv(job.output_path, out.sample_ids,
-                                     out.coords)
-            return out
+            return _emit_coords(job, grun.sample_ids,
+                                np.asarray(res.coords),
+                                np.asarray(res.eigenvalues), timer,
+                                grun.n_variants, method="dense")
 
     sim = run_similarity(job, source=source)
     if job.compute.backend == "cpu-reference":
@@ -231,11 +230,8 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
                 fit_pca(sim.similarity.astype(np.float32), k=k)
             )
         coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
-    sim.timer.add("eigh_flops", eigh_flops(sim.similarity.shape[0]))
-    out = CoordsOutput(sim.sample_ids, coords, vals, sim.timer, sim.n_variants)
-    if job.output_path:
-        pio.write_coords_tsv(job.output_path, out.sample_ids, out.coords)
-    return out
+    return _emit_coords(job, sim.sample_ids, coords, vals, sim.timer,
+                        sim.n_variants, method="dense")
 
 
 def _eigh_method(eigh_mode: str, n: int) -> str:

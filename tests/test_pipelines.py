@@ -193,3 +193,6 @@ def test_genotype_histogram(rng):
         assert c.missing == (col == -1).sum()
     sel = genotype_histogram(src, block_variants=32, positions={5, 7})
     assert [c.position for c in sel] == [5, 7]
+    # an EMPTY position set matches nothing (None means full scan) —
+    # truthiness would silently flip it into a complete scan
+    assert genotype_histogram(src, block_variants=32, positions=set()) == []
