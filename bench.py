@@ -379,10 +379,17 @@ def bench_tile_rate() -> dict:
 
 
 def bench_streaming(store: str) -> dict:
-    """Config 5: incremental PCoA overhead on a 256k-variant prefix."""
+    """Config 5: incremental PCoA on a 256k-variant prefix.
+
+    Refreshes are dispatched async and overlap the stream's transfers,
+    so their honest cost is end-to-end: streamed time WITH mid-stream
+    snapshots minus the same stream as a plain pcoa job. Both runs use
+    the same prefix, block size, and (warm) compiled programs.
+    """
     from spark_examples_tpu.core.config import (
         ComputeConfig, IngestConfig, JobConfig,
     )
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
     from spark_examples_tpu.pipelines.streaming import incremental_pcoa_job
 
     nv = 262_144
@@ -391,24 +398,44 @@ def bench_streaming(store: str) -> dict:
         compute=ComputeConfig(metric=METRIC, num_pc=K,
                               stream_refresh_blocks=4),
     )
-    src = _slice_store(store, nv)
+    # Warm both paths at identical shapes (8 blocks: enough for one
+    # mid-stream refresh plus the terminal tighten) — the persistent
+    # compile cache does not survive processes on the axon platform, so
+    # an unwarmed run times compilation, not the framework (measured:
+    # ~11 s of "overhead" that vanishes warm).
+    warm = 8 * BLOCK
+    pcoa_job(job, source=_slice_store(store, warm))
+    incremental_pcoa_job(job, source=_slice_store(store, warm))
+
     t0 = time.perf_counter()
-    out, snaps = incremental_pcoa_job(job, source=src)
+    plain = pcoa_job(job, source=_slice_store(store, nv))
+    plain_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out, snaps = incremental_pcoa_job(job, source=_slice_store(store, nv))
     total_s = time.perf_counter() - t0
-    rep = out.timer.report()
-    refresh_s = rep.get("stream_refresh", 0.0)
     n_snaps = len(snaps)
-    log(f"config5 streaming pcoa: {total_s:.2f}s on {nv} variants, "
-        f"{n_snaps} snapshots, refresh total {refresh_s:.2f}s "
-        f"({refresh_s / max(n_snaps, 1):.3f}s each), overhead "
-        f"{100 * refresh_s / max(total_s - refresh_s, 1e-9):.1f}%")
+    delta = total_s - plain_s
+    # Snapshot quality: each mid-stream snapshot is itself a valid
+    # smaller-stream PCoA; the FINAL incremental coords must match the
+    # batch solve (also pinned at small N by tests/test_streaming.py).
+    sep_final = check_structure(out.coords)
+    overhead_pct = 100 * delta / plain_s
+    log(f"config5 streaming pcoa: {total_s:.2f}s with {n_snaps} snapshots "
+        f"vs {plain_s:.2f}s plain on {nv} variants -> overhead "
+        f"{delta:+.2f}s ({overhead_pct:+.1f}%); final separation "
+        f"{sep_final:.1f}x")
     return {
         "n_variants": nv, "total_s": round(total_s, 2),
+        "plain_stream_s": round(plain_s, 2),
         "snapshots": n_snaps,
-        "refresh_s_total": round(refresh_s, 3),
-        "refresh_s_each": round(refresh_s / max(n_snaps, 1), 4),
-        "overhead_pct": round(
-            100 * refresh_s / max(total_s - refresh_s, 1e-9), 1
+        "overhead_s": round(delta, 2),
+        "overhead_pct": round(overhead_pct, 1),
+        "note": (
+            "refreshes dispatch async and overlap the transfer-bound "
+            "stream; overhead = streamed-with minus streamed-without — "
+            "values near or below zero mean refresh cost is under the "
+            "host-link variance between the two runs"
         ),
         "coords": out.coords,
     }

@@ -35,6 +35,7 @@ def _leaf_sum_program(leaf):
 # the one-time trace+compile charge amortizes across every phase instead
 # of re-paying per distinct tree signature.
 _leaf_sum = jax.jit(_leaf_sum_program)
+_warned_fallback = False
 
 
 def hard_sync(tree):
@@ -63,9 +64,26 @@ def hard_sync(tree):
             s = _leaf_sum(leaf)
             total = s if total is None else total + s  # eager async add
         np.asarray(total)
-    except Exception:
+    except Exception as e:
         # Mixed-mesh / committed-device trees whose scalars can't be
         # combined in one place: fall back to one element per shard.
+        # Warn ONCE — the fallback pays a host round-trip per shard per
+        # leaf, the exact per-phase timing inflation the checksum path
+        # exists to remove, and silent degradation would quietly deflate
+        # every reported TFLOP/s number.
+        global _warned_fallback
+        if not _warned_fallback:
+            _warned_fallback = True
+            import warnings
+
+            warnings.warn(
+                f"hard_sync checksum barrier failed ({type(e).__name__}: "
+                f"{e}); falling back to per-shard element fetches — "
+                "timed phases now include one host RTT per shard per "
+                "leaf and reported throughputs will read low",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         for leaf in leaves:
             shards = getattr(leaf, "addressable_shards", None)
             if shards:
