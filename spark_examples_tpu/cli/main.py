@@ -29,9 +29,10 @@ from spark_examples_tpu.core.config import (
 def _add_common(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("ingest")
     g.add_argument("--source", default="synthetic",
-                   choices=["synthetic", "vcf", "packed"])
+                   choices=["synthetic", "vcf", "packed", "plink"])
     g.add_argument("--path", default=None,
-                   help="input file/dir for vcf or packed sources")
+                   help="input for vcf (.vcf/.vcf.gz), packed (store "
+                   "dir), or plink (fileset prefix or .bed path) sources")
     g.add_argument("--references", nargs="*", default=[],
                    metavar="CONTIG:START:END",
                    help="genomic ranges to ingest (VCF region filter)")
@@ -60,9 +61,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "replicated", "variant", "tile2d"])
     c.add_argument("--eigh-mode", default="auto",
                    choices=["auto", "dense", "randomized"])
-    c.add_argument("--braycurtis-method", default="exact",
-                   choices=["exact", "matmul", "pallas"],
-                   help="braycurtis lowering: elementwise VPU path, "
+    c.add_argument("--braycurtis-method", default="auto",
+                   choices=["auto", "exact", "matmul", "pallas"],
+                   help="braycurtis lowering: auto (pallas on an "
+                   "accelerator, exact on CPU), elementwise VPU path, "
                    "threshold-decomposed MXU matmuls (quantised), or the "
                    "fused-VMEM Pallas kernel (interpreted on CPU)")
     c.add_argument("--braycurtis-levels", type=int, default=256)

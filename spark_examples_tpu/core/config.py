@@ -80,11 +80,14 @@ class ComputeConfig:
     # a real sentinel, so drivers can tell an explicit choice from an
     # unset field.
     metric: str | None = None
-    # braycurtis lowering: "exact" (VPU elementwise), "matmul"
-    # (threshold-decomposed MXU path, quantised to `braycurtis_levels`),
-    # or "pallas" (fused VMEM kernel — ops/pallas; exact like "exact",
-    # interpreted when the backend is CPU so tests stay hardware-free).
-    braycurtis_method: str = "exact"
+    # braycurtis lowering: "auto" picks "pallas" on an accelerator
+    # (measured fastest AND exact — BASELINE.md config 3) and "exact"
+    # on CPU (the Pallas interpreter is for correctness, not speed);
+    # "exact" (VPU elementwise), "matmul" (threshold-decomposed MXU
+    # path, quantised to `braycurtis_levels`), "pallas" (fused VMEM
+    # kernel — ops/pallas; interpreted when the backend is CPU so tests
+    # stay hardware-free).
+    braycurtis_method: str = "auto"
     braycurtis_levels: int = 256
     num_pc: int = 10
     # GRM only: accumulate Z Z^T in f32 instead of bf16 — roughly half

@@ -1,10 +1,15 @@
 from spark_examples_tpu.ingest import (  # noqa: F401
     bitpack,
     packed,
+    plink,
     prefetch,
     source,
     synthetic,
     vcf,
+)
+from spark_examples_tpu.ingest.plink import (  # noqa: F401
+    PlinkSource,
+    write_plink,
 )
 from spark_examples_tpu.ingest.packed import (  # noqa: F401
     Packed2BitSource,

@@ -30,6 +30,7 @@ from spark_examples_tpu.core.config import IngestConfig, JobConfig
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.ingest import (
     ArraySource,
+    PlinkSource,
     SyntheticSource,
     VcfSource,
     load_packed,
@@ -91,6 +92,13 @@ def build_source(cfg: IngestConfig):
         if not cfg.path:
             raise ValueError("packed source requires ingest.path")
         return load_packed(cfg.path)
+    if cfg.source == "plink":
+        if not cfg.path:
+            raise ValueError(
+                "plink source requires ingest.path (fileset prefix or "
+                ".bed path)"
+            )
+        return PlinkSource(cfg.path)
     raise ValueError(f"unknown source {cfg.source!r}")
 
 
@@ -286,11 +294,17 @@ def _run_braycurtis(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResu
         x = _materialize(source, job.ingest.block_variants)
         x = np.maximum(x, 0)  # missing (-1) counts as absence
     method = job.compute.braycurtis_method
-    if method not in ("exact", "matmul", "pallas"):
+    if method not in ("auto", "exact", "matmul", "pallas"):
         raise ValueError(
             f"unknown braycurtis_method {method!r}; "
-            "valid: exact | matmul | pallas"
+            "valid: auto | exact | matmul | pallas"
         )
+    if method == "auto":
+        # Pallas is both the fastest and an exact lowering on real TPU
+        # hardware (BASELINE.md config 3: 0.33 s vs matmul 1.25 s at
+        # N=10k) — but it is a Mosaic kernel, TPU-only, so every other
+        # backend (CPU, GPU) takes the portable exact path.
+        method = "pallas" if jax.default_backend() == "tpu" else "exact"
     if job.compute.backend == "cpu-reference":
         with timer.phase("distance"):
             d = oracle.cpu_braycurtis(x)
