@@ -27,9 +27,11 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
+from typing import Sequence
 
 import numpy as np
 
+from spark_examples_tpu.core.config import ReferenceRange
 from spark_examples_tpu.ingest.source import BlockMeta
 
 _MAGIC = bytes([0x6C, 0x1B])
@@ -50,12 +52,21 @@ def _resolve_prefix(path: str) -> str:
 
 @dataclass
 class PlinkSource:
-    """PLINK fileset as a GenotypeSource (``--source plink``)."""
+    """PLINK fileset as a GenotypeSource (``--source plink``).
+
+    ``references``: optional genomic ranges (the reference's
+    ``--references chr:start:end`` semantics, same as VcfSource) — only
+    variants inside one of the ranges stream. Block/resume ordinals
+    then index the *filtered* stream, exactly like VCF's record
+    ordinals, so cursors stay valid for the geometry that made them.
+    """
 
     path: str
+    references: Sequence[ReferenceRange] = ()
     _ids: list[str] | None = field(default=None, repr=False)
     _chroms: np.ndarray | None = field(default=None, repr=False)
     _positions: np.ndarray | None = field(default=None, repr=False)
+    _sel: np.ndarray | None = field(default=None, repr=False)
 
     def __post_init__(self):
         self.prefix = _resolve_prefix(self.path)
@@ -102,30 +113,62 @@ class PlinkSource:
     def n_samples(self) -> int:
         return len(self._read_fam())
 
+    def _selection(self) -> np.ndarray:
+        """Indices of the variants that stream (all, or in-range);
+        cached — the O(V x ranges) mask is rebuilt otherwise on every
+        ``n_variants`` touch, and the runner touches it several times
+        per job."""
+        if self._sel is None:
+            chroms, positions = self._read_bim()
+            if not self.references:
+                self._sel = np.arange(chroms.shape[0])
+            else:
+                mask = np.zeros(chroms.shape[0], bool)
+                for r in self.references:
+                    mask |= (
+                        (chroms == r.contig)
+                        & (positions >= r.start)
+                        & (positions < r.end)
+                    )
+                self._sel = np.nonzero(mask)[0]
+        return self._sel
+
     @property
     def n_variants(self) -> int:
-        return int(self._read_bim()[0].shape[0])
+        return int(self._selection().shape[0])
 
     def _bed_rows(self) -> np.ndarray:
-        """(V, ceil(N/4)) uint8 memmap of the .bed payload."""
-        n, v = self.n_samples, self.n_variants
-        bpr = -(-n // 4)  # bytes per variant row
+        """(V_total, ceil(N/4)) uint8 memmap of the .bed payload — the
+        FILE's variant count (every .bim row), not the filtered
+        ``n_variants``: the selection indexes into these rows."""
+        v_total = int(self._read_bim()[0].shape[0])
+        bpr = -(-self.n_samples // 4)  # bytes per variant row
         return np.memmap(self.prefix + ".bed", np.uint8, mode="r",
-                         offset=3, shape=(v, bpr))
+                         offset=3, shape=(v_total, bpr))
 
     def blocks(self, block_variants: int, start_variant: int = 0):
         """(N, <=block_variants) int8 dosage blocks, chromosome-flush.
 
         Decode: LUT over the (w, ceil(N/4)) byte rows -> (w, 4*ceil(N/4))
         -> slice N -> transpose to the framework's sample-major layout.
+        Block start/stop are ordinals of the (possibly range-filtered)
+        stream; contiguous selections slice the memmap, filtered ones
+        fancy-index it.
         """
         chroms, positions = self._read_bim()
-        n, v = self.n_samples, self.n_variants
+        n = self.n_samples
+        sel = self._selection()
+        v = sel.shape[0]
+        if v == 0:
+            return
         rows = self._bed_rows()
-        # Fixed grid, split at chromosome boundaries (matching VCF's
-        # "blocks never span a contig" contract).
-        bounds = [0] + (np.nonzero(chroms[1:] != chroms[:-1])[0] + 1
-                        ).tolist() + [v]
+        # Fixed grid over the selected stream, split at chromosome
+        # boundaries (matching VCF's "blocks never span a contig"
+        # contract).
+        sel_chroms = chroms[sel]
+        bounds = [0] + (
+            np.nonzero(sel_chroms[1:] != sel_chroms[:-1])[0] + 1
+        ).tolist() + [v]
         idx = 0
         for s in range(len(bounds) - 1):
             seg_lo, seg_hi = bounds[s], bounds[s + 1]
@@ -139,12 +182,17 @@ class PlinkSource:
                 if hi <= start_variant:
                     idx += 1
                     continue
-                dense = _LUT[rows[lo:hi]]  # (w, bpr, 4)
+                take = sel[lo:hi]
+                if take[-1] - take[0] == hi - lo - 1:  # contiguous run
+                    raw = rows[take[0] : take[-1] + 1]  # memmap view
+                else:
+                    raw = rows[take]  # gather (filtered selection)
+                dense = _LUT[raw]  # (w, bpr, 4)
                 block = np.ascontiguousarray(
                     dense.reshape(hi - lo, -1)[:, :n].T
                 )
                 yield block, BlockMeta(
-                    idx, lo, hi, str(chroms[lo]), positions[lo:hi]
+                    idx, lo, hi, str(sel_chroms[lo]), positions[take]
                 )
                 idx += 1
 

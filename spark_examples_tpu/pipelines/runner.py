@@ -58,6 +58,25 @@ def finalize_field(acc, metric: str, field: str):
     return distances.finalize(acc, metric)[field]
 
 
+def _maybe_partitioned(cls, cfg: IngestConfig):
+    """Range-filterable file source, optionally split into concurrent
+    sub-range readers — the reference's FixedContigSplits(n): one reader
+    per sub-range, read concurrently, consumed in range order (identical
+    stream for position-sorted non-overlapping ranges — the
+    partitioner's own precondition). Applies uniformly to every source
+    class taking ``(path, references=...)``."""
+    if cfg.splits_per_contig > 1 and cfg.references:
+        from spark_examples_tpu.ingest.partitioned import PartitionedSource
+        from spark_examples_tpu.ingest.source import partition_ranges
+
+        parts = [
+            cls(cfg.path, references=(r,))
+            for r in partition_ranges(cfg.references, cfg.splits_per_contig)
+        ]
+        return PartitionedSource(parts, max_workers=cfg.ingest_workers)
+    return cls(cfg.path, references=tuple(cfg.references))
+
+
 def build_source(cfg: IngestConfig):
     """IngestConfig -> GenotypeSource (the reference's L2/L3 factory)."""
     if cfg.source == "synthetic":
@@ -70,24 +89,7 @@ def build_source(cfg: IngestConfig):
     if cfg.source == "vcf":
         if not cfg.path:
             raise ValueError("vcf source requires ingest.path")
-        if cfg.splits_per_contig > 1 and cfg.references:
-            # The reference's FixedContigSplits(n): one reader per
-            # sub-range, read concurrently, consumed in range order
-            # (identical stream for position-sorted non-overlapping
-            # ranges — the partitioner's own precondition).
-            from spark_examples_tpu.ingest.partitioned import (
-                PartitionedSource,
-            )
-            from spark_examples_tpu.ingest.source import partition_ranges
-
-            parts = [
-                VcfSource(cfg.path, references=(r,))
-                for r in partition_ranges(
-                    cfg.references, cfg.splits_per_contig
-                )
-            ]
-            return PartitionedSource(parts, max_workers=cfg.ingest_workers)
-        return VcfSource(cfg.path, references=tuple(cfg.references))
+        return _maybe_partitioned(VcfSource, cfg)
     if cfg.source == "packed":
         if not cfg.path:
             raise ValueError("packed source requires ingest.path")
@@ -98,7 +100,7 @@ def build_source(cfg: IngestConfig):
                 "plink source requires ingest.path (fileset prefix or "
                 ".bed path)"
             )
-        return PlinkSource(cfg.path)
+        return _maybe_partitioned(PlinkSource, cfg)
     raise ValueError(f"unknown source {cfg.source!r}")
 
 

@@ -106,6 +106,58 @@ def test_plink_resume_on_chromosome_irregular_grid(rng, tmp_path):
     np.testing.assert_array_equal(resumed[0][0], full[3][0])
 
 
+def test_plink_references_filter(rng, tmp_path):
+    """--references chr:start:end semantics (VcfSource parity): only
+    in-range variants stream; ordinals index the filtered stream."""
+    from spark_examples_tpu.core.config import ReferenceRange
+
+    g = random_genotypes(rng, n=5, v=30)
+    prefix = str(tmp_path / "c")
+    write_plink(prefix, g, chroms=["1"] * 15 + ["2"] * 15,
+                positions=np.arange(100, 130))
+    refs = (ReferenceRange("1", 105, 110),  # variants 5..9
+            ReferenceRange("2", 120, 125))  # variants 20..24
+    src = PlinkSource(prefix, references=refs)
+    assert src.n_variants == 10
+    blocks = list(src.blocks(4))
+    out = np.concatenate([b for b, _ in blocks], axis=1)
+    np.testing.assert_array_equal(
+        out, np.concatenate([g[:, 5:10], g[:, 20:25]], axis=1)
+    )
+    # ordinals are filtered-stream ordinals; contigs stay exact
+    assert [(m.start, m.stop, m.contig) for _, m in blocks] == [
+        (0, 4, "1"), (4, 5, "1"), (5, 9, "2"), (9, 10, "2")
+    ]
+    assert list(blocks[1][1].positions) == [109]
+    # resume over the filtered stream
+    resumed = list(src.blocks(4, start_variant=5))
+    np.testing.assert_array_equal(resumed[0][0], blocks[2][0])
+
+
+def test_partitioned_plink_pipeline_parity(rng, tmp_path):
+    """--splits-per-contig routes PLINK through PartitionedSource (the
+    FixedContigSplits successor) and matches the unsplit ingest."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig, ReferenceRange,
+    )
+    from spark_examples_tpu.pipelines import runner
+
+    g = random_genotypes(rng, n=10, v=400, missing_rate=0.1)
+    prefix = str(tmp_path / "c")
+    write_plink(prefix, g, chroms=["1"] * 400,
+                positions=np.arange(1000, 1400))
+    base = dict(source="plink", path=prefix,
+                references=[ReferenceRange("1", 0, 10_000)],
+                block_variants=64)
+    r_seq = runner.run_similarity(JobConfig(
+        ingest=IngestConfig(**base), compute=ComputeConfig(metric="ibs")))
+    r_par = runner.run_similarity(JobConfig(
+        ingest=IngestConfig(**base, splits_per_contig=3, ingest_workers=2),
+        compute=ComputeConfig(metric="ibs")))
+    np.testing.assert_array_equal(r_seq.similarity, r_par.similarity)
+    assert r_seq.n_variants == r_par.n_variants == 400
+
+
 def test_plink_pcoa_pipeline(rng, tmp_path):
     """End to end: PLINK fileset -> packed transport -> IBS PCoA matches
     the same cohort ingested as a dense array."""
