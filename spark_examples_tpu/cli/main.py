@@ -156,6 +156,13 @@ def main(argv: list[str] | None = None) -> int:
     _add_common(p_sv)
     p_sv.add_argument("--positions", nargs="*", type=int, default=None)
 
+    p_pack = sub.add_parser(
+        "pack",
+        help="ETL: stream any source into the 2-bit packed store "
+        "(parse once; later jobs read zero-copy packed bytes)",
+    )
+    _add_common(p_pack)
+
     p_cov = sub.add_parser("coverage",
                            help="per-base read coverage over ranges "
                            "(the SearchReads example tier)")
@@ -281,6 +288,24 @@ def _dispatch(args, parser, job, J, build_source) -> int:
             if job.output_path:
                 tail += f" (full table in {job.output_path})"
             print(tail)
+        return 0
+    elif args.command == "pack":
+        import time as _time
+
+        from spark_examples_tpu.ingest.packed import pack_source
+
+        if not job.output_path:
+            parser.error("pack requires --output-path (the store dir)")
+        src = build_source(job.ingest)
+        t0 = _time.perf_counter()
+        written = pack_source(job.output_path, src,
+                              job.ingest.block_variants)
+        dt = _time.perf_counter() - t0
+        print(
+            f"packed {src.n_samples} samples x {written} variants "
+            f"({src.n_samples * written / 4 / 1e6:.1f} MB 2-bit) -> "
+            f"{job.output_path} in {dt:.1f}s"
+        )
         return 0
     else:  # pragma: no cover
         parser.error(f"unknown command {args.command}")

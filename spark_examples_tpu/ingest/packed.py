@@ -30,6 +30,38 @@ from spark_examples_tpu.ingest import bitpack
 from spark_examples_tpu.ingest.source import ArraySource, BlockMeta
 
 
+def _write_sidecar(
+    path: str,
+    n_samples: int,
+    n_variants: int,
+    bits: int,
+    sample_ids: list[str] | None,
+    contig: str | None,
+    positions: np.ndarray | None,
+    contig_runs: list[tuple[str | None, int]] | None = None,
+) -> None:
+    """The store's meta.json + positions.npy, shared by every writer so
+    the schema can't drift between save_packed and pack_source.
+
+    ``contig_runs``: [(name, start_index), ...] for multi-chromosome
+    cohorts — run i spans [start_i, start_{i+1}).
+    """
+    meta = {
+        "n_samples": int(n_samples),
+        "n_variants": int(n_variants),
+        "bits": bits,
+        "sample_ids": sample_ids,
+        "contig": contig,
+    }
+    if contig_runs is not None:
+        meta["contig_runs"] = [[c, int(s)] for c, s in contig_runs]
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    if positions is not None:
+        np.save(os.path.join(path, "positions.npy"),
+                np.asarray(positions, np.int64))
+
+
 def save_packed(
     path: str,
     genotypes: np.ndarray,
@@ -47,18 +79,8 @@ def save_packed(
     else:
         np.save(os.path.join(path, "genotypes.npy"),
                 np.ascontiguousarray(genotypes, dtype=GENOTYPE_DTYPE))
-    meta = {
-        "n_samples": int(genotypes.shape[0]),
-        "n_variants": int(genotypes.shape[1]),
-        "bits": bits,
-        "sample_ids": sample_ids,
-        "contig": contig,
-    }
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f)
-    if positions is not None:
-        np.save(os.path.join(path, "positions.npy"),
-                np.asarray(positions, np.int64))
+    _write_sidecar(path, genotypes.shape[0], genotypes.shape[1], bits,
+                   sample_ids, contig, positions)
 
 
 @dataclass
@@ -75,6 +97,9 @@ class Packed2BitSource:
     ids: list[str] | None = None
     contig: str | None = None
     positions: np.ndarray | None = None
+    # Multi-chromosome stores: [(name, start_index), ...] — run i spans
+    # [start_i, start_{i+1}). None = single-contig store (``contig``).
+    contig_runs: list | None = None
 
     @property
     def n_samples(self) -> int:
@@ -90,10 +115,33 @@ class Packed2BitSource:
             return self.ids
         return [f"S{i:06d}" for i in range(self.n_samples)]
 
+    def _contig_of(self, lo: int, hi: int) -> str | None:
+        """Contig of the variant range [lo, hi) — None when the range
+        spans a run boundary (multi-contig stores pack continuously, so
+        a byte-aligned packed block can straddle chromosomes)."""
+        if self.contig_runs is None:
+            return self.contig
+        name = None
+        for c, s in self.contig_runs:
+            if s <= lo:
+                name = c
+            elif s < hi:
+                return None  # a later run starts inside the range
+        return name
+
+    def _bounds(self) -> list[int]:
+        """Segment boundaries dense blocks must not cross."""
+        if not self.contig_runs:
+            return [0, self.v]
+        starts = [int(s) for _, s in self.contig_runs]
+        return starts + [self.v]
+
     def packed_blocks(self, block_variants: int, start_variant: int = 0):
         """Yield ((N, <=block_variants/4) uint8, meta) zero-copy byte
         slices. Requires ``block_variants`` divisible by 4 so blocks fall
-        on byte boundaries (``blocks()`` has no such restriction)."""
+        on byte boundaries (``blocks()`` has no such restriction). The
+        fixed byte grid can straddle chromosome runs; such blocks carry
+        ``contig=None`` (positions stay exact)."""
         if block_variants % bitpack.VARIANTS_PER_BYTE:
             raise ValueError(
                 f"packed_blocks needs block_variants divisible by "
@@ -111,24 +159,107 @@ class Packed2BitSource:
             pos = None
             if self.positions is not None:
                 pos = self.positions[lo:hi]
-            yield block, BlockMeta(idx, lo, hi, self.contig, pos)
+            yield block, BlockMeta(idx, lo, hi, self._contig_of(lo, hi),
+                                   pos)
 
     def blocks(self, block_variants: int, start_variant: int = 0):
-        """Dense int8 blocks of any width: unpack the covering byte range
-        and slice off the sub-byte offset."""
+        """Dense int8 blocks: unpack the covering byte range and slice
+        off the sub-byte offset. Blocks never span a chromosome run
+        (VCF/PLINK parity), so ``meta.contig`` is exact; resume skips
+        any block starting before the cursor (ceil-align for mid-block
+        cursors, exact for self-produced stops — both geometries only
+        ever see cursors they made)."""
         vpb = bitpack.VARIANTS_PER_BYTE
-        first = -(-start_variant // block_variants)
-        for idx in range(first, -(-self.v // block_variants)):
-            lo = idx * block_variants
-            hi = min(lo + block_variants, self.v)
-            dense = bitpack.unpack_dosages_np(
-                self.packed[:, lo // vpb : -(-hi // vpb)]
+        bounds = self._bounds()
+        idx = 0
+        for s in range(len(bounds) - 1):
+            for lo in range(bounds[s], bounds[s + 1], block_variants):
+                hi = min(lo + block_variants, bounds[s + 1])
+                if lo < start_variant:
+                    idx += 1
+                    continue
+                dense = bitpack.unpack_dosages_np(
+                    self.packed[:, lo // vpb : -(-hi // vpb)]
+                )
+                block = dense[:, lo % vpb : lo % vpb + (hi - lo)]
+                pos = None
+                if self.positions is not None:
+                    pos = self.positions[lo:hi]
+                yield block, BlockMeta(idx, lo, hi,
+                                       self._contig_of(lo, hi), pos)
+                idx += 1
+
+
+def pack_source(
+    path: str,
+    source,
+    block_variants: int = 16384,
+) -> int:
+    """Stream any GenotypeSource into a 2-bit store in one pass — the
+    ETL tier (the reference's BigQuery-export job shape): parse once,
+    then every later job reads zero-copy packed bytes.
+
+    The (N, ceil(V/4)) uint8 matrix is preallocated as a memmapped .npy
+    (variant count comes from the source) and filled block-by-block at
+    byte offsets, so the cohort never materializes dense in host RAM.
+    Returns the number of variants written.
+    """
+    vpb = bitpack.VARIANTS_PER_BYTE
+    n, v = source.n_samples, source.n_variants
+    os.makedirs(path, exist_ok=True)
+    out = np.lib.format.open_memmap(
+        os.path.join(path, "genotypes.2bit.npy"), mode="w+",
+        dtype=np.uint8, shape=(n, bitpack.packed_width(v)),
+    )
+    positions = np.full(v, -1, np.int64)
+    runs: list[tuple[str | None, int]] = []  # (contig, start) per run
+    written = 0  # variants consumed from the stream
+    flushed = 0  # variants whose bytes have landed (always % 4 == 0)
+    carry = np.empty((n, 0), np.int8)  # sub-byte tail awaiting alignment
+
+    def flush(cols: np.ndarray, final: bool = False) -> np.ndarray:
+        """Write the byte-aligned prefix of ``cols``; return the rest.
+        Contig-flush blocks make arbitrary widths — a sub-byte tail must
+        wait for the next block (packing it early would misalign every
+        later variant by the pad codes)."""
+        nonlocal flushed
+        aligned = cols.shape[1] if final else cols.shape[1] // vpb * vpb
+        if aligned:
+            pb = bitpack.pack_dosages(np.ascontiguousarray(
+                cols[:, :aligned]
+            ))
+            out[:, flushed // vpb : flushed // vpb + pb.shape[1]] = pb
+            flushed += aligned
+        return cols[:, aligned:]
+
+    for block, meta in source.blocks(block_variants):
+        if meta.start != written:
+            raise ValueError(
+                f"non-contiguous block stream: expected start {written}, "
+                f"got {meta.start}"
             )
-            block = dense[:, lo % vpb : lo % vpb + (hi - lo)]
-            pos = None
-            if self.positions is not None:
-                pos = self.positions[lo:hi]
-            yield block, BlockMeta(idx, lo, hi, self.contig, pos)
+        if meta.positions is not None:
+            positions[meta.start : meta.stop] = meta.positions
+        if not runs or runs[-1][0] != meta.contig:
+            runs.append((meta.contig, meta.start))
+        written = meta.stop
+        carry = flush(
+            np.concatenate([carry, block], axis=1) if carry.size else block
+        )
+    flush(carry, final=True)
+    if written != v:
+        raise ValueError(
+            f"source stream ended at {written} of {v} declared variants"
+        )
+    out.flush()
+    single = runs[0][0] if len(runs) == 1 else None
+    _write_sidecar(
+        path, n, v, 2, source.sample_ids,
+        contig=single,
+        positions=positions if (positions >= 0).all() else None,
+        contig_runs=runs if len(runs) > 1 else None,
+    )
+    return written
 
 
 def load_packed(path: str, mmap: bool = True):
@@ -141,12 +272,14 @@ def load_packed(path: str, mmap: bool = True):
     mode = "r" if mmap else None
     if meta.get("bits", 8) == 2:
         p = np.load(os.path.join(path, "genotypes.2bit.npy"), mmap_mode=mode)
+        runs = meta.get("contig_runs")
         return Packed2BitSource(
             packed=p,
             v=meta["n_variants"],
             ids=meta.get("sample_ids"),
             contig=meta.get("contig"),
             positions=positions,
+            contig_runs=[(c, int(s)) for c, s in runs] if runs else None,
         )
     g = np.load(os.path.join(path, "genotypes.npy"), mmap_mode=mode)
     return ArraySource(

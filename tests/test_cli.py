@@ -97,3 +97,30 @@ def test_cli_trace_dir_captures_profile(tmp_path, capsys):
         for f in fs
     ]
     assert found, "no jax.profiler trace files written"
+
+
+def test_cli_pack_then_pcoa(tmp_path, capsys):
+    """The ETL handoff: pack a VCF into the 2-bit store, then run PCoA
+    from the store — same coordinates as straight from the VCF."""
+    from spark_examples_tpu.ingest import write_vcf
+
+    rng = np.random.default_rng(3)
+    g = rng.integers(0, 3, (12, 300)).astype(np.int8)
+    vcf = str(tmp_path / "c.vcf")
+    write_vcf(vcf, g, contig="chr1", start_pos=500)
+    store = str(tmp_path / "store")
+    cap = _run(capsys, "pack", "--source", "vcf", "--path", vcf,
+               "--block-variants", "64", "--output-path", store)
+    assert "packed 12 samples x 300 variants" in cap.out
+
+    from_store = str(tmp_path / "a.tsv")
+    from_vcf = str(tmp_path / "b.tsv")
+    _run(capsys, "pcoa", "--source", "packed", "--path", store,
+         "--block-variants", "64", "--num-pc", "3",
+         "--output-path", from_store)
+    _run(capsys, "pcoa", "--source", "vcf", "--path", vcf,
+         "--block-variants", "64", "--num-pc", "3",
+         "--output-path", from_vcf)
+    a = np.loadtxt(from_store, skiprows=1, usecols=(1, 2, 3))
+    b = np.loadtxt(from_vcf, skiprows=1, usecols=(1, 2, 3))
+    np.testing.assert_allclose(np.abs(a), np.abs(b), atol=1e-5)
