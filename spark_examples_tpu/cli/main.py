@@ -52,7 +52,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
     c.add_argument("--metric", default="ibs",
-                   choices=["ibs", "ibs2", "shared-alt", "grm", "euclidean",
+                   choices=["ibs", "ibs2", "shared-alt", "grm", "king",
+                            "euclidean",
                             "dot", "braycurtis"])
     c.add_argument("--num-pc", type=int, default=10)
     c.add_argument("--mesh-shape", default=None,

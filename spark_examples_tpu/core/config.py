@@ -72,8 +72,8 @@ class ComputeConfig:
     """Compute-path knobs."""
 
     backend: str = "jax-tpu"  # jax-tpu | cpu-reference
-    # Gram-path metrics: ibs | ibs2 | shared-alt | grm | euclidean | dot
-    # (streamed genotype blocks). "braycurtis" is valid at the pipeline
+    # Gram-path metrics: ibs | ibs2 | shared-alt | grm | king |
+    # euclidean | dot (streamed genotype blocks). "braycurtis" is valid at the pipeline
     # level only — it dispatches to the dense-table distances.braycurtis
     # path, not the gram accumulator. None means "the driver's default"
     # (ibs for similarity/pcoa; the PCA driver always uses shared-alt) —

@@ -55,6 +55,27 @@ def finalize(acc: dict, metric: str) -> dict[str, jnp.ndarray]:
     if metric == "dot":
         dot = stats["dot"].astype(jnp.float32)
         return {"similarity": dot, "distance": similarity_to_distance(dot)}
+    if metric == "king":
+        # KING-robust kinship (Manichaikul 2010, between-family form):
+        # phi = (N_AaAa - 2 * N_AA,aa) / (N_Aa(i) + N_Aa(j)), hets
+        # counted over pairwise-complete variants. The diagonal lands on
+        # 0.5 by construction (hc_ii == hh_ii). Pairs sharing no het
+        # variants are uninformative -> phi 0 (unrelated), same spirit
+        # as ibs's zero-overlap convention.
+        den = (stats["hc"] + stats["hc"].T).astype(jnp.float32)
+        num = (stats["hh"] - 2 * stats["opp"]).astype(jnp.float32)
+        phi = jnp.where(den > 0, num / den, 0.0)
+        # Pin the diagonal to self-kinship 0.5 even for samples with
+        # zero het calls (inbred lines, haploid 0/2 coding), whose
+        # den_ii = 0 would otherwise fall into the "unrelated" branch —
+        # and a nonzero self-distance would poison the Gower centering
+        # every downstream PCoA applies.
+        n = phi.shape[0]
+        phi = jnp.where(jnp.eye(n, dtype=bool), 0.5, phi)
+        # Kinship distance: 0.5 - phi (0 for self/MZ, ~0.5 unrelated,
+        # clipped: sampling noise can push phi past the 0.5 bound).
+        return {"similarity": phi,
+                "distance": jnp.maximum(0.5 - phi, 0.0)}
     raise ValueError(f"unknown metric {metric!r}")
 
 

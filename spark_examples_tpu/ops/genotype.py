@@ -54,6 +54,7 @@ import numpy as np
 PRODUCT_OPERANDS: dict[str, tuple[str, str]] = {
     "cc": ("c", "c"),
     "t1c": ("t1", "c"),
+    "t2c": ("t2", "c"),
     "yc": ("y", "c"),
     "qc": ("qr", "c"),
     "yy": ("yr", "yr"),
@@ -77,6 +78,10 @@ PIECE_PRODUCTS: dict[str, tuple[str, ...]] = {
     "ibs2": ("cc", "t1c", "t1t1", "t1t2", "t2t2"),
     "dot": ("yy",),
     "e2": ("qc", "yy"),
+    # KING-robust kinship components (het = T1 - T2, homref = C - T1):
+    "hh": ("t1t1", "t1t2", "t2t2"),
+    "opp": ("t2c", "t1t2"),
+    "hc": ("t1c", "t2c"),
 }
 
 
@@ -210,6 +215,24 @@ def combine_products(
             out["dot"] = prod["yy"]
         elif piece == "e2":
             out["e2"] = prod["qc"] + _t(prod["qc"]) - 2 * prod["yy"]
+        elif piece == "hh":
+            # het-het co-occurrence: H H^T with H = T1 - T2
+            out["hh"] = (
+                prod["t1t1"] - prod["t1t2"] - _t(prod["t1t2"])
+                + prod["t2t2"]
+            )
+        elif piece == "opp":
+            # opposite-homozygote counts, both directions:
+            # X0 X2^T + X2 X0^T with X0 = C - T1 (hom-ref), X2 = T2;
+            # X0 X2^T = (T2 C^T)^T - T1 T2^T.
+            out["opp"] = (
+                prod["t2c"] + _t(prod["t2c"])
+                - prod["t1t2"] - _t(prod["t1t2"])
+            )
+        elif piece == "hc":
+            # hc[i, j] = # variants where i is het AND j's call is valid
+            # (non-symmetric; the KING denominator uses hc + hc^T)
+            out["hc"] = prod["t1c"] - prod["t2c"]
         else:
             raise ValueError(f"unknown gram piece {piece!r}")
     return out

@@ -47,6 +47,7 @@ PIECES_FOR_METRIC: dict[str, tuple[str, ...]] = {
     "shared-alt": ("t1t1",),
     "euclidean": ("qc", "yy"),
     "dot": ("yy",),
+    "king": ("t1c", "t2c", "t1t1", "t1t2", "t2t2"),
 }
 
 # Statistics (genotype.combine_products names) each metric's finalize needs.
@@ -56,6 +57,7 @@ STATS_FOR_METRIC: dict[str, tuple[str, ...]] = {
     "shared-alt": ("s",),
     "euclidean": ("e2",),
     "dot": ("dot",),
+    "king": ("hh", "opp", "hc"),
 }
 
 GRAM_METRICS = tuple(PIECES_FOR_METRIC) + ("grm",)
@@ -65,7 +67,7 @@ GRAM_METRICS = tuple(PIECES_FOR_METRIC) + ("grm",)
 # raw-value products for arbitrary int8 tables (values >= 0; negatives are
 # missing), which the 2-bit codec cannot represent, so auto keeps them on
 # the dense transport.
-DOSAGE_METRICS = ("ibs", "ibs2", "shared-alt", "grm")
+DOSAGE_METRICS = ("ibs", "ibs2", "shared-alt", "grm", "king")
 
 # int32 accumulator budget: worst per-variant increment by metric, for
 # the runner's exactness guard (increment * n_variants must stay < 2^31).
@@ -78,6 +80,7 @@ MAX_INCREMENT: dict[str, int] = {
     "shared-alt": 1,
     "euclidean": 4,  # qc/yy at dosage values; m^2 in general
     "dot": 4,
+    "king": 2,       # finalize sums hc + hc^T / hh - 2*opp in int32
 }
 
 

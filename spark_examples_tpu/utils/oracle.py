@@ -50,6 +50,26 @@ def naive_ibs_distance(genotypes: np.ndarray) -> np.ndarray:
     return d
 
 
+def naive_king(genotypes: np.ndarray) -> np.ndarray:
+    """KING-robust kinship by explicit per-pair counting — deliberately
+    NOT derived from the matmul combine algebra, so it independently
+    pins the reformulation (Manichaikul 2010 between-family estimator,
+    pairwise-complete variants)."""
+    g = genotypes.astype(np.int64)
+    n = g.shape[0]
+    phi = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            valid = (g[i] >= 0) & (g[j] >= 0)
+            a, b = g[i][valid], g[j][valid]
+            het_het = int(((a == 1) & (b == 1)).sum())
+            opp = int((((a == 0) & (b == 2)) | ((a == 2) & (b == 0))).sum())
+            den = int((a == 1).sum() + (b == 1).sum())
+            phi[i, j] = (het_het - 2 * opp) / den if den > 0 else 0.0
+    np.fill_diagonal(phi, 0.5)  # self-kinship by definition
+    return phi
+
+
 def naive_braycurtis(x: np.ndarray) -> np.ndarray:
     n = x.shape[0]
     d = np.zeros((n, n))
@@ -183,6 +203,13 @@ def cpu_finalize(acc: dict, metric: str) -> dict:
         return {"similarity": -d, "distance": d}
     if metric == "dot":
         return {"similarity": acc["dot"], "distance": gower(acc["dot"])}
+    if metric == "king":
+        den = acc["hc"] + acc["hc"].T
+        with np.errstate(invalid="ignore", divide="ignore"):
+            phi = np.where(den > 0, (acc["hh"] - 2 * acc["opp"]) / den, 0.0)
+        np.fill_diagonal(phi, 0.5)  # self-kinship even with zero hets
+        return {"similarity": phi,
+                "distance": np.maximum(0.5 - phi, 0.0)}
     raise ValueError(f"unknown metric {metric!r}")
 
 

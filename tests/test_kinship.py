@@ -1,0 +1,98 @@
+"""KING-robust kinship (--metric king): matmul reformulation vs the
+independent per-pair oracle, planted-relatedness recovery, and the
+streaming/packed paths."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.ingest.bitpack import pack_dosages
+from spark_examples_tpu.ops import distances, gram
+from spark_examples_tpu.utils import oracle
+from tests.conftest import random_genotypes
+
+
+def _phi(g):
+    acc = gram.update(gram.init(g.shape[0], "king"), g, "king")
+    return np.asarray(distances.finalize(acc, "king")["similarity"])
+
+
+def test_king_matches_naive_oracle(rng):
+    g = random_genotypes(rng, n=18, v=600, missing_rate=0.15)
+    np.testing.assert_allclose(_phi(g), oracle.naive_king(g), atol=1e-6)
+
+
+def test_king_diagonal_is_half(rng):
+    g = random_genotypes(rng, n=10, v=400, missing_rate=0.05)
+    # sample 0: fully homozygous (inbred-line / haploid 0-2 coding) —
+    # its zero het count must NOT demote self-kinship to "unrelated";
+    # a nonzero self-distance would poison downstream Gower centering
+    g[0] = np.where(g[0] == 1, 2, g[0])
+    phi = _phi(g)
+    np.testing.assert_allclose(np.diagonal(phi), 0.5, atol=1e-7)
+    acc = gram.update(gram.init(10, "king"), g, "king")
+    d = np.asarray(distances.finalize(acc, "king")["distance"])
+    np.testing.assert_allclose(np.diagonal(d), 0.0, atol=1e-7)
+
+
+def test_king_recovers_planted_relatedness(rng):
+    """Duplicate (MZ-twin analog) ~0.5; parent-child ~0.25; unrelated
+    ~0, on allele-level simulated genotypes."""
+    v = 20_000
+    p = rng.uniform(0.2, 0.8, v)
+    # unrelated founders as explicit allele pairs
+    a = (rng.random((4, v)) < p).astype(np.int8)
+    b = (rng.random((4, v)) < p).astype(np.int8)
+    founders = a + b
+    # child of founders 0 and 1: one transmitted allele from each
+    child = (
+        np.where(rng.random(v) < 0.5, a[0], b[0])
+        + np.where(rng.random(v) < 0.5, a[1], b[1])
+    ).astype(np.int8)
+    cohort = np.concatenate(
+        [founders, child[None, :], founders[0:1].copy()], axis=0
+    )  # rows: f0 f1 f2 f3 child dup(f0)
+    phi = _phi(cohort)
+    assert abs(phi[0, 5] - 0.5) < 0.02   # duplicate pair
+    assert abs(phi[0, 4] - 0.25) < 0.03  # parent-child
+    assert abs(phi[4, 1] - 0.25) < 0.03  # other parent
+    assert abs(phi[2, 3]) < 0.03         # unrelated founders
+    assert abs(phi[0, 2]) < 0.03
+
+
+def test_king_streaming_and_packed_match_single_block(rng):
+    g = random_genotypes(rng, n=12, v=512, missing_rate=0.1)
+    whole = _phi(g)
+    acc = gram.init(12, "king")
+    for s in range(0, 512, 128):
+        acc = gram.update(acc, g[:, s : s + 128], "king")
+    np.testing.assert_allclose(
+        np.asarray(distances.finalize(acc, "king")["similarity"]),
+        whole, atol=1e-7,
+    )
+    pacc = gram.update_packed(
+        gram.init(12, "king"), pack_dosages(g), "king"
+    )
+    np.testing.assert_allclose(
+        np.asarray(distances.finalize(pacc, "king")["similarity"]),
+        whole, atol=1e-7,
+    )
+
+
+def test_king_pipeline_job(rng, tmp_path):
+    """similarity job surface with --metric king writes the phi matrix."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.pipelines.runner import run_similarity
+
+    g = random_genotypes(rng, n=14, v=300, missing_rate=0.1)
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=64),
+        compute=ComputeConfig(metric="king"),
+    )
+    res = run_similarity(job, source=ArraySource(g))
+    np.testing.assert_allclose(
+        res.similarity, oracle.naive_king(g), atol=1e-6
+    )
+    assert res.metric == "king"
