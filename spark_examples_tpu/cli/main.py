@@ -118,6 +118,7 @@ def _job_from_args(args) -> JobConfig:
             checkpoint_every_blocks=args.checkpoint_every_blocks,
         ),
         output_path=args.output_path,
+        model_path=getattr(args, "save_model", None),
     )
 
 
@@ -144,6 +145,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="streaming mode: emit coordinate snapshots "
                         "every N blocks via warm rank-k subspace "
                         "refreshes (incremental PCoA)")
+    p_pcoa.add_argument("--save-model", default=None,
+                        help="persist the fitted embedding (.npz) so "
+                        "`project` can later place new samples into "
+                        "this coordinate space")
 
     p_pca = sub.add_parser("pca", help="flagship variants-PCA driver")
     _add_common(p_pca)
@@ -156,6 +161,20 @@ def main(argv: list[str] | None = None) -> int:
                           help="genotype histograms at positions")
     _add_common(p_sv)
     p_sv.add_argument("--positions", nargs="*", type=int, default=None)
+
+    p_proj = sub.add_parser(
+        "project",
+        help="place NEW samples into a fitted reference PCoA space "
+        "(out-of-sample Nystrom extension; fit with pcoa --save-model)",
+    )
+    _add_common(p_proj)  # --source/--path describe the NEW cohort
+    p_proj.add_argument("--model", required=True,
+                        help=".npz from pcoa --save-model")
+    p_proj.add_argument("--ref-source", default="plink",
+                        choices=["synthetic", "vcf", "packed", "plink"],
+                        help="reference cohort genotypes (the panel the "
+                        "model was fitted on)")
+    p_proj.add_argument("--ref-path", default=None)
 
     p_pack = sub.add_parser(
         "pack",
@@ -244,6 +263,13 @@ def _dispatch(args, parser, job, J, build_source) -> int:
             if args.matrix_path:
                 parser.error("--stream-refresh-blocks streams the cohort; "
                              "it cannot consume a persisted --matrix-path")
+            if args.save_model:
+                parser.error(
+                    "--save-model is not supported by the streaming "
+                    "route (it needs the final dense distance matrix "
+                    "for the projection centering statistics) — fit "
+                    "the model with a batch pcoa run"
+                )
             job = job.replace(compute=_dc.replace(
                 job.compute, stream_refresh_blocks=refresh))
             out, snapshots = incremental_pcoa_job(job)
@@ -290,6 +316,23 @@ def _dispatch(args, parser, job, J, build_source) -> int:
                 tail += f" (full table in {job.output_path})"
             print(tail)
         return 0
+    elif args.command == "project":
+        import dataclasses as _dc
+
+        from spark_examples_tpu.pipelines.project import pcoa_project_job
+
+        if not args.ref_path and args.ref_source != "synthetic":
+            parser.error("project requires --ref-path (the panel "
+                         "genotypes the model was fitted on)")
+        ref_cfg = _dc.replace(job.ingest, source=args.ref_source,
+                              path=args.ref_path)
+        out = pcoa_project_job(
+            job, model_path=args.model,
+            source_new=build_source(job.ingest),
+            source_ref=build_source(ref_cfg),
+        )
+        _print_coords(out, job)
+        timer = out.timer
     elif args.command == "pack":
         import time as _time
 

@@ -118,6 +118,10 @@ class JobConfig:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     compute: ComputeConfig = field(default_factory=ComputeConfig)
     output_path: str | None = None
+    # pcoa only: persist the fitted embedding (eigenpairs + centering
+    # statistics) so `project` can later place NEW samples into this
+    # coordinate space without refitting (pipelines/project.py).
+    model_path: str | None = None
 
     def replace(self, **kw) -> "JobConfig":
         return dataclasses.replace(self, **kw)

@@ -58,6 +58,14 @@ def pcoa_job(
     similarity job records what it wrote) and falls back to distance.
     """
     k = job.compute.num_pc
+    if matrix_path is not None and job.model_path:
+        raise ValueError(
+            "--save-model cannot be combined with --matrix-path: the "
+            "persisted matrix does not record which metric built it, "
+            "and a model stamped with the wrong metric would project "
+            "silently wrong coordinates — fit the model from a cohort "
+            "stream instead"
+        )
     if matrix_path is not None:
         sample_ids, m, file_kind = pio.read_matrix(matrix_path)
         kind = matrix_kind if matrix_kind != "auto" else (file_kind or "distance")
@@ -101,8 +109,20 @@ def pcoa_job(
                 fit_pcoa(dist.astype(np.float32), k=k, method=method)
             )
         coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
+    _maybe_save_model(job, dist, coords, vals, sample_ids)
     return _emit_coords(job, sample_ids, coords, vals, timer, n_variants,
                         method=method)
+
+
+def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
+    """Persist the fitted embedding when the job asks for it
+    (pipelines/project.py consumes it to place new samples)."""
+    if not job.model_path:
+        return
+    from spark_examples_tpu.pipelines.project import save_model
+
+    save_model(job.model_path, coords, vals, np.asarray(dist),
+               sample_ids, job.compute.metric or "ibs")
 
 
 def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
@@ -148,6 +168,14 @@ def _pcoa_device_route(job: JobConfig, source, timer) -> CoordsOutput | None:
     plan = runner.plan_for_job(job, source)
     if plan.mode == "tile2d" and cfg.eigh_mode == "dense":
         return None  # dense eigh requires the materialized matrix
+    if plan.mode == "tile2d" and job.model_path:
+        # Fail BEFORE streaming the cohort: discovering this after a
+        # multi-hour 76k-regime accumulation would discard all of it.
+        raise ValueError(
+            "--save-model needs the dense distance matrix for the "
+            "projection centering statistics; the tile2d plan never "
+            "materializes it — fit the model with gram_mode=variant"
+        )
     grun = runner.run_gram(job, source, timer, plan=plan)
     if plan.mode == "tile2d":
         res = pcoa_coords_sharded(plan, grun.acc, metric, k=cfg.num_pc,
@@ -161,6 +189,8 @@ def _pcoa_device_route(job: JobConfig, source, timer) -> CoordsOutput | None:
         method = _eigh_method(cfg.eigh_mode, dist.shape[0])
         with timer.phase("eigh"):
             res = hard_sync(fit_pcoa(dist, k=cfg.num_pc, method=method))
+        _maybe_save_model(job, np.asarray(dist), np.asarray(res.coords),
+                          np.asarray(res.eigenvalues), grun.sample_ids)
     return _emit_coords(job, grun.sample_ids, np.asarray(res.coords),
                         np.asarray(res.eigenvalues), timer,
                         grun.n_variants, method=method)

@@ -1,0 +1,187 @@
+"""Out-of-sample PCoA projection (Nystrom / Gower extension).
+
+The reference family's flagship use case was placing cohorts in
+1000-Genomes ancestry space (SURVEY.md §0, §4 "Golden values"); the
+workflow people actually run is *fit once on a reference panel, then
+project new samples into the same coordinates* — refitting on
+reference+new moves every axis. This module adds that second half:
+
+1. ``pcoa --save-model`` persists the fitted embedding: eigenvectors V,
+   eigenvalues lambda, and the reference D^2 column/grand means the
+   Gower centering needs (:func:`save_model` — one .npz).
+2. ``project`` streams the NEW cohort against the REFERENCE genotypes
+   (same variants), accumulating the cross statistics as int32 matmul
+   products (:func:`spark_examples_tpu.ops.genotype.cross_stats` — the
+   same MXU shape as the symmetric gram), finalizes the (A, N_ref)
+   distance block on device, and applies Gower's out-of-sample formula:
+
+       b_a   = -1/2 (d2_a - mean(d2_a) - colmean_ref + grand_ref)
+       y_a   = b_a V diag(lambda)^{-1/2}
+
+   Projecting the reference's own samples through this path reproduces
+   their fitted coordinates exactly (B V = V diag(lambda)), which is the
+   invariant the tests pin.
+
+Supported metrics: the IBS family (``ibs``) — the distance the PCoA
+entrypoint family is defined on.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_examples_tpu.core.config import JobConfig
+from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
+from spark_examples_tpu.ingest.prefetch import stream_to_device
+from spark_examples_tpu.ops import genotype
+from spark_examples_tpu.pipelines import io as pio
+from spark_examples_tpu.pipelines.jobs import CoordsOutput
+
+CROSS_STATS_FOR_METRIC = {"ibs": ("m", "d1")}
+
+
+def save_model(
+    path: str,
+    coords: np.ndarray,
+    eigenvalues: np.ndarray,
+    distance: np.ndarray,
+    sample_ids: list[str],
+    metric: str,
+) -> None:
+    """Persist a fitted PCoA embedding for later projection.
+
+    ``coords`` = V sqrt(lambda) (the job output), so V is recovered by
+    dividing out sqrt(lambda); components with lambda <= 0 are dropped
+    (they carry no metric information and their V is undefined).
+    """
+    vals = np.asarray(eigenvalues, np.float64)
+    keep = vals > 0
+    v = np.asarray(coords, np.float64)[:, keep] / np.sqrt(vals[keep])
+    d2 = np.asarray(distance, np.float64) ** 2
+    np.savez(
+        path,
+        eigvecs=v,
+        eigvals=vals[keep],
+        d2_colmean=d2.mean(axis=0),
+        d2_grand=np.float64(d2.mean()),
+        sample_ids=np.asarray(sample_ids),
+        metric=np.asarray(metric),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _update_cross(acc, bn, br):
+    upd = genotype.cross_stats(bn, br, tuple(acc))
+    return {k: acc[k] + upd[k] for k in acc}
+
+
+@partial(jax.jit, static_argnames=())
+def _project(m, d1, d2_colmean, d2_grand, eigvecs, eigvals):
+    dist = jnp.where(m > 0, d1.astype(jnp.float32) / (2.0 * m), 0.0)
+    d2 = dist * dist
+    b = -0.5 * (
+        d2
+        - d2.mean(axis=1, keepdims=True)
+        - d2_colmean[None, :]
+        + d2_grand
+    )
+    return (b @ eigvecs) / jnp.sqrt(eigvals)[None, :]
+
+
+def pcoa_project_job(
+    job: JobConfig,
+    model_path: str,
+    source_new,
+    source_ref,
+) -> CoordsOutput:
+    """Project ``source_new``'s samples into a fitted reference space.
+
+    Both sources must stream the SAME variants in the same order (the
+    reference workflow: both cohorts genotyped at the panel's sites);
+    block widths and, when available, positions are validated as the
+    two streams are zipped.
+    """
+    with np.load(model_path, allow_pickle=False) as mdl:
+        metric = str(mdl["metric"])
+        if metric not in CROSS_STATS_FOR_METRIC:
+            raise ValueError(
+                f"model metric {metric!r} is not projectable "
+                f"(supported: {sorted(CROSS_STATS_FOR_METRIC)})"
+            )
+        n_ref = mdl["eigvecs"].shape[0]
+        model_ids = [str(s) for s in mdl["sample_ids"]]
+        if model_ids != list(source_ref.sample_ids):
+            raise ValueError(
+                "reference source sample ids do not match the panel the "
+                f"model was fitted on ({source_ref.n_samples} vs "
+                f"{len(model_ids)} samples"
+                + (
+                    "; ids differ"
+                    if source_ref.n_samples == len(model_ids)
+                    else ""
+                )
+                + ") — cross-distances against the wrong genotypes "
+                "would project silently wrong coordinates"
+            )
+        eigvecs = jnp.asarray(mdl["eigvecs"], jnp.float32)
+        eigvals = jnp.asarray(mdl["eigvals"], jnp.float32)
+        d2_colmean = jnp.asarray(mdl["d2_colmean"], jnp.float32)
+        d2_grand = jnp.float32(mdl["d2_grand"])
+
+    timer = PhaseTimer()
+    stats = CROSS_STATS_FOR_METRIC[metric]
+    a = source_new.n_samples
+    if source_new.n_variants != source_ref.n_variants:
+        raise ValueError(
+            f"new cohort has {source_new.n_variants} variants but the "
+            f"reference has {source_ref.n_variants} — both must carry "
+            "the same variant set (a silent prefix-zip would compute "
+            "distances on partial data)"
+        )
+    bv = job.ingest.block_variants
+    acc = {k: jnp.zeros((a, n_ref), jnp.int32) for k in stats}
+    n_variants = 0
+    with timer.phase("gram"):
+        ref_stream = stream_to_device(source_ref, bv)
+        new_stream = stream_to_device(source_new, bv)
+        for (bn, mn), (br, mr) in zip(new_stream, ref_stream):
+            if (mn.start, mn.stop) != (mr.start, mr.stop):
+                raise ValueError(
+                    "new/reference streams diverged: new block "
+                    f"[{mn.start}, {mn.stop}) vs ref [{mr.start}, "
+                    f"{mr.stop}) — both cohorts must carry the same "
+                    "variants (same sites, same order)"
+                )
+            if (
+                mn.positions is not None
+                and mr.positions is not None
+                and not np.array_equal(mn.positions, mr.positions)
+            ):
+                raise ValueError(
+                    f"new/reference positions differ in block "
+                    f"[{mn.start}, {mn.stop}) — not the same variant set"
+                )
+            acc = _update_cross(acc, bn, br)
+            n_matmuls = sum(
+                len(genotype.CROSS_STATS[s]) for s in stats
+            )
+            timer.add("gram_flops",
+                      2.0 * a * n_ref * bn.shape[1] * n_matmuls)
+            timer.add("ingest_bytes", bn.size + br.size)
+            n_variants = mn.stop
+        acc = hard_sync(acc)
+    # One fused device step: finalize cross distances + Gower extension
+    # + eigvec products; only the (A, k) coordinates come home.
+    with timer.phase("eigh"):
+        coords = np.asarray(hard_sync(_project(
+            acc["m"], acc["d1"], d2_colmean, d2_grand, eigvecs, eigvals
+        )))
+    out = CoordsOutput(source_new.sample_ids, coords,
+                       np.asarray(eigvals), timer, n_variants)
+    if job.output_path:
+        pio.write_coords_tsv(job.output_path, out.sample_ids, out.coords)
+    return out

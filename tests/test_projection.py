@@ -1,0 +1,126 @@
+"""Out-of-sample PCoA projection: exactness on the training cohort,
+ancestry placement of held-out samples, and stream-mismatch guards."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core.config import (
+    ComputeConfig, IngestConfig, JobConfig,
+)
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.pipelines.jobs import pcoa_job
+from spark_examples_tpu.pipelines.project import pcoa_project_job
+from tests.conftest import random_genotypes
+
+
+def _cohort(rng, n, v, pops=3):
+    labels = rng.integers(0, pops, n)
+    p = (0.05 + 0.9 * rng.random((pops, v)))[labels]
+    g = (
+        (rng.random((n, v)) < p).astype(np.int8)
+        + (rng.random((n, v)) < p).astype(np.int8)
+    )
+    return g, labels
+
+
+def test_project_training_samples_is_exact(rng, tmp_path):
+    """B V = V diag(lambda): pushing the reference's own samples through
+    the projection path reproduces their fitted coordinates."""
+    g = random_genotypes(rng, n=20, v=500, missing_rate=0.1)
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=128),
+        compute=ComputeConfig(metric="ibs", num_pc=5),
+        model_path=model,
+    )
+    fitted = pcoa_job(job, source=ArraySource(g))
+    out = pcoa_project_job(
+        job.replace(model_path=None),
+        model_path=model,
+        source_new=ArraySource(g),
+        source_ref=ArraySource(g),
+    )
+    k = out.coords.shape[1]  # lambda<=0 components dropped by the model
+    np.testing.assert_allclose(
+        out.coords, fitted.coords[:, :k], atol=1e-3
+    )
+
+
+def test_project_places_heldout_by_ancestry(rng, tmp_path):
+    """Held-out samples project near their own population's centroid."""
+    g, labels = _cohort(rng, n=90, v=4000)
+    ref, new = g[:60], g[60:]
+    lr, ln = labels[:60], labels[60:]
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=512),
+        compute=ComputeConfig(metric="ibs", num_pc=4),
+        model_path=model,
+    )
+    fitted = pcoa_job(job, source=ArraySource(ref))
+    out = pcoa_project_job(
+        job.replace(model_path=None), model_path=model,
+        source_new=ArraySource(new), source_ref=ArraySource(ref),
+    )
+    cents = np.stack(
+        [fitted.coords[lr == c, :2].mean(0) for c in range(3)]
+    )
+    for i in range(len(ln)):
+        d = np.linalg.norm(out.coords[i, :2] - cents, axis=1)
+        assert d.argmin() == ln[i]
+
+
+def test_project_rejects_mismatched_streams(rng, tmp_path):
+    g = random_genotypes(rng, n=10, v=256)
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=64),
+        compute=ComputeConfig(metric="ibs", num_pc=3),
+        model_path=model,
+    )
+    pcoa_job(job, source=ArraySource(g))
+    with pytest.raises(ValueError, match="variants"):
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(g[:, :200]),  # fewer variants
+            source_ref=ArraySource(g),
+        )
+    with pytest.raises(ValueError, match="fitted on"):
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(g),
+            source_ref=ArraySource(g[:6]),  # wrong panel size
+        )
+    with pytest.raises(ValueError, match="fitted on"):
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(g),
+            # same size, different cohort: ids must not match either
+            source_ref=ArraySource(
+                g, ids=[f"OTHER{i}" for i in range(10)]
+            ),
+        )
+
+
+def test_project_cli_flow(rng, tmp_path, capsys):
+    """pcoa --save-model then project, through the real CLI."""
+    from spark_examples_tpu.cli.main import main
+    from spark_examples_tpu.ingest.plink import write_plink
+
+    g, labels = _cohort(rng, n=40, v=1500)
+    ref, new = g[:30], g[30:]
+    refp, newp = str(tmp_path / "ref"), str(tmp_path / "new")
+    write_plink(refp, ref)
+    write_plink(newp, new)
+    model = str(tmp_path / "m.npz")
+    coords = str(tmp_path / "proj.tsv")
+    assert main(["pcoa", "--source", "plink", "--path", refp,
+                 "--block-variants", "256", "--num-pc", "3",
+                 "--save-model", model]) == 0
+    assert main(["project", "--source", "plink", "--path", newp,
+                 "--ref-source", "plink", "--ref-path", refp,
+                 "--block-variants", "256", "--model", model,
+                 "--output-path", coords]) == 0
+    got = np.loadtxt(coords, skiprows=1, usecols=(1, 2, 3))
+    assert got.shape == (10, 3)
+    capsys.readouterr()
