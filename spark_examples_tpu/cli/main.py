@@ -48,6 +48,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "FixedContigSplits); 1 disables")
     g.add_argument("--ingest-workers", type=int, default=4,
                    help="concurrent range readers for --splits-per-contig")
+    g.add_argument("--maf", type=float, default=0.0,
+                   help="drop variants with minor-allele frequency below "
+                   "this (QC stream filter)")
+    g.add_argument("--max-missing", type=float, default=1.0,
+                   help="drop variants with missing-call rate above this")
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
@@ -103,6 +108,8 @@ def _job_from_args(args) -> JobConfig:
             seed=args.seed,
             splits_per_contig=args.splits_per_contig,
             ingest_workers=args.ingest_workers,
+            maf=args.maf,
+            max_missing=args.max_missing,
         ),
         compute=ComputeConfig(
             backend=args.backend,
@@ -324,6 +331,15 @@ def _dispatch(args, parser, job, J, build_source) -> int:
         if not args.ref_path and args.ref_source != "synthetic":
             parser.error("project requires --ref-path (the panel "
                          "genotypes the model was fitted on)")
+        if args.maf > 0.0 or args.max_missing < 1.0:
+            parser.error(
+                "--maf/--max-missing cannot apply during project: the "
+                "QC mask is data-dependent, so each cohort would keep a "
+                "DIFFERENT variant subset and cross-distances would mix "
+                "misaligned variants. QC-filter the panel once (pack "
+                "--maf ... into a store), fit the model on that store, "
+                "and supply a new cohort genotyped at the same sites"
+            )
         ref_cfg = _dc.replace(job.ingest, source=args.ref_source,
                               path=args.ref_path)
         out = pcoa_project_job(

@@ -65,6 +65,12 @@ class IngestConfig:
     # — the emitted stream is identical to the sequential one). 1 = off.
     splits_per_contig: int = 1
     ingest_workers: int = 4
+    # Variant QC thresholds, applied as a stream transform over any
+    # source (ingest/filters.py): drop variants with minor-allele
+    # frequency < maf or missing-call rate > max_missing. Defaults are
+    # no-ops.
+    maf: float = 0.0
+    max_missing: float = 1.0
 
 
 @dataclass

@@ -1,0 +1,146 @@
+"""Variant QC filtering as a stream transform (``--maf``,
+``--max-missing``).
+
+The reference filtered variants in its RDD map stage ("filter variants
+with >=1 non-ref call", SURVEY.md §3.1); real pipelines additionally
+drop rare variants and high-missingness sites before kinship/PCA. Here
+that is a source wrapper: per incoming block the allele frequency and
+missing rate are computed vectorized on the host (producer side — the
+chip never sees dropped columns, so filtering also shrinks transport),
+surviving columns are re-chunked into steady ``block_variants``-wide
+blocks, and contig boundaries still flush (the wrapped stream keeps the
+"blocks never span a contig" contract).
+
+Ordinals index the FILTERED stream — deterministic for a fixed
+source+thresholds, so resume cursors stay valid (the filter re-derives
+the same kept set on every pass). ``n_variants`` requires counting the
+kept set, i.e. a full pass over the inner source; it is computed lazily
+and cached, and the streaming jobs never call it (they count from
+``meta.stop`` precisely to avoid such scans).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from spark_examples_tpu.ingest.source import BlockMeta
+
+
+def qc_mask(block: np.ndarray, maf: float, max_missing: float) -> np.ndarray:
+    """Boolean keep-mask over the block's variant axis.
+
+    MAF = min(p, 1-p) with p the alt-allele frequency over called
+    genotypes (dosage mean / 2); variants with zero calls have
+    missing rate 1.0 and undefined MAF -> dropped whenever either
+    threshold is active.
+    """
+    valid = block >= 0
+    n_called = valid.sum(axis=0)
+    miss = 1.0 - n_called / block.shape[0]
+    keep = miss <= max_missing
+    if maf > 0.0:
+        with np.errstate(invalid="ignore"):
+            p = np.where(
+                n_called > 0,
+                np.where(valid, block, 0).sum(axis=0) / (2.0 * n_called),
+                np.nan,
+            )
+        keep &= np.minimum(p, 1.0 - p) >= maf
+    return keep
+
+
+@dataclass
+class FilteredSource:
+    """QC-filtered view of any GenotypeSource."""
+
+    inner: object
+    maf: float = 0.0
+    max_missing: float = 1.0
+    _n_variants: int | None = field(default=None, repr=False)
+
+    @property
+    def sample_ids(self) -> list[str]:
+        return self.inner.sample_ids
+
+    @property
+    def n_samples(self) -> int:
+        return self.inner.n_samples
+
+    @property
+    def n_variants(self) -> int:
+        """Kept-variant count — a full pass over the inner source
+        (lazy, cached; the streaming jobs count from meta.stop and
+        never call this)."""
+        if self._n_variants is None:
+            count = 0
+            for block, _ in self.inner.blocks(16384):
+                count += int(qc_mask(block, self.maf, self.max_missing).sum())
+            self._n_variants = count
+        return self._n_variants
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        cols: list[np.ndarray] = []
+        pos: list[np.ndarray] = []
+        cur_contig: str | None = None
+        idx = 0
+        emitted = 0
+
+        def flush():
+            nonlocal cols, pos, idx, emitted
+            block = np.concatenate(cols, axis=1)
+            positions = (
+                np.concatenate(pos) if all(p is not None for p in pos)
+                else None
+            )
+            meta = BlockMeta(idx, emitted, emitted + block.shape[1],
+                             cur_contig, positions)
+            emitted += block.shape[1]
+            idx += 1
+            cols, pos = [], []
+            return block, meta
+
+        for block, meta in self.inner.blocks(block_variants):
+            keep = qc_mask(block, self.maf, self.max_missing)
+            if cols and meta.contig != cur_contig:
+                yield from self._emit(flush, start_variant)
+            cur_contig = meta.contig
+            if not keep.any():
+                continue
+            kept = np.ascontiguousarray(block[:, keep])
+            cols.append(kept)
+            pos.append(
+                np.asarray(meta.positions)[keep]
+                if meta.positions is not None else None
+            )
+            # Emit steady-width blocks as soon as enough columns buffer.
+            while sum(c.shape[1] for c in cols) >= block_variants:
+                buf = np.concatenate(cols, axis=1)
+                bp = (
+                    np.concatenate(pos)
+                    if all(p is not None for p in pos) else None
+                )
+                head, tail = buf[:, :block_variants], buf[:, block_variants:]
+                cols = [np.ascontiguousarray(tail)] if tail.shape[1] else []
+                if bp is not None:
+                    hp, tp = bp[:block_variants], bp[block_variants:]
+                    pos = [tp] if tail.shape[1] else []
+                else:
+                    hp = None
+                    pos = [None] if tail.shape[1] else []
+                meta_out = BlockMeta(idx, emitted,
+                                     emitted + block_variants,
+                                     cur_contig, hp)
+                emitted += block_variants
+                idx += 1
+                if meta_out.start >= start_variant:
+                    yield np.ascontiguousarray(head), meta_out
+        if cols:
+            yield from self._emit(flush, start_variant)
+
+    @staticmethod
+    def _emit(flush, start_variant):
+        block, meta = flush()
+        if meta.start >= start_variant:
+            yield block, meta

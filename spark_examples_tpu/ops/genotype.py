@@ -122,6 +122,46 @@ def _xxt(a: jnp.ndarray, b: jnp.ndarray, accum_dtype) -> jnp.ndarray:
     )
 
 
+def _weighted_products(
+    spec: dict[str, tuple[tuple[tuple[str, str], int], ...]],
+    ops_l: dict[str, jnp.ndarray],
+    ops_r: dict[str, jnp.ndarray],
+    accum_dtype,
+) -> dict[str, jnp.ndarray]:
+    """name -> sum_w w * (opL @ opR^T), shared by the symmetric and
+    cross-cohort paths.
+
+    The optimization barrier materialises each operand once: without it,
+    XLA fuses the threshold computation into every dot's operand read,
+    so each indicator is recomputed by every matmul that consumes it and
+    the VPU work throttles the MXU pipeline (measured ~30% throughput
+    loss on the 4-product IBS update). For the symmetric case pass the
+    same dict for both sides — each operand is then barriered once.
+    """
+    used_l = sorted({l for terms in spec.values() for (l, _), _ in terms})
+    used_r = sorted({r for terms in spec.values() for (_, r), _ in terms})
+    if ops_l is ops_r:
+        used = sorted(set(used_l) | set(used_r))
+        vals = jax.lax.optimization_barrier(tuple(ops_l[o] for o in used))
+        ops_l = ops_r = dict(zip(used, vals))
+    else:
+        vals = jax.lax.optimization_barrier(
+            tuple(ops_l[o] for o in used_l)
+            + tuple(ops_r[o] for o in used_r)
+        )
+        ops_l = dict(zip(used_l, vals[: len(used_l)]))
+        ops_r = dict(zip(used_r, vals[len(used_l):]))
+    out = {}
+    for p, terms in spec.items():
+        acc = None
+        for (l, r), w in terms:
+            prod = _xxt(ops_l[l], ops_r[r], accum_dtype)
+            prod = prod * w if w != 1 else prod
+            acc = prod if acc is None else acc + prod
+        out[p] = acc
+    return out
+
+
 def gram_products(
     block: jnp.ndarray,
     products: tuple[str, ...],
@@ -136,12 +176,6 @@ def gram_products(
     into resident int32 accumulators — exact while the per-variant
     increment times the stream length stays under 2^31 (< 2^29 variants
     for dosage inputs, whose worst increment is 4).
-
-    The optimization barrier materialises each operand once: without it,
-    XLA fuses the threshold computation into every dot's operand read, so
-    each indicator is recomputed by every matmul that consumes it and the
-    VPU work throttles the MXU pipeline (measured ~30% throughput loss on
-    the 4-product IBS update).
     """
     integer = np.issubdtype(np.dtype(accum_dtype), np.integer)
     ops = operands(block)
@@ -158,22 +192,7 @@ def gram_products(
         dt = np.dtype(accum_dtype)
         ops = {k: v.astype(dt) for k, v in ops.items()}
         spec = {p: ((PRODUCT_OPERANDS[p], 1),) for p in products}
-    used = sorted(
-        {name for terms in spec.values() for (l, r), _ in terms
-         for name in (l, r)}
-    )
-    ops = dict(zip(used, jax.lax.optimization_barrier(
-        tuple(ops[o] for o in used)
-    )))
-    out = {}
-    for p, terms in spec.items():
-        acc = None
-        for (l, r), w in terms:
-            prod = _xxt(ops[l], ops[r], accum_dtype)
-            prod = prod * w if w != 1 else prod
-            acc = prod if acc is None else acc + prod
-        out[p] = acc
-    return out
+    return _weighted_products(spec, ops, ops, accum_dtype)
 
 
 def combine_products(
@@ -271,28 +290,12 @@ def cross_stats(
     the accumulation the Nystrom/out-of-sample PCoA projection streams
     (pipelines/project.py).
     """
-    ops_n = operands(block_new)
-    ops_r = operands(block_ref)
-    # Same barrier as gram_products: materialise each operand once, or
-    # XLA fuses the indicator thresholds into every consuming matmul's
-    # operand read (measured ~30% throughput loss on the 4-product
-    # symmetric update).
-    used_n = sorted({l for s in stats for (l, _), _ in CROSS_STATS[s]})
-    used_r = sorted({r for s in stats for (_, r), _ in CROSS_STATS[s]})
-    vals = jax.lax.optimization_barrier(
-        tuple(ops_n[o] for o in used_n) + tuple(ops_r[o] for o in used_r)
+    return _weighted_products(
+        {s: CROSS_STATS[s] for s in stats},
+        operands(block_new),
+        operands(block_ref),
+        accum_dtype,
     )
-    ops_n = dict(zip(used_n, vals[: len(used_n)]))
-    ops_r = dict(zip(used_r, vals[len(used_n):]))
-    out = {}
-    for s in stats:
-        acc = None
-        for (l, r), w in CROSS_STATS[s]:
-            prod = _xxt(ops_n[l], ops_r[r], accum_dtype)
-            prod = prod * w if w != 1 else prod
-            acc = prod if acc is None else acc + prod
-        out[s] = acc
-    return out
 
 
 def gram_pieces(block: jnp.ndarray, accum_dtype=jnp.int32) -> dict[str, jnp.ndarray]:

@@ -39,6 +39,7 @@ from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.ingest.prefetch import stream_to_device
 from spark_examples_tpu.ops import genotype
 from spark_examples_tpu.pipelines import io as pio
+from spark_examples_tpu.pipelines import runner as R
 from spark_examples_tpu.pipelines.jobs import CoordsOutput
 
 CROSS_STATS_FOR_METRIC = {"ibs": ("m", "d1")}
@@ -135,20 +136,29 @@ def pcoa_project_job(
     timer = PhaseTimer()
     stats = CROSS_STATS_FOR_METRIC[metric]
     a = source_new.n_samples
-    if source_new.n_variants != source_ref.n_variants:
-        raise ValueError(
-            f"new cohort has {source_new.n_variants} variants but the "
-            f"reference has {source_ref.n_variants} — both must carry "
-            "the same variant set (a silent prefix-zip would compute "
-            "distances on partial data)"
-        )
     bv = job.ingest.block_variants
     acc = {k: jnp.zeros((a, n_ref), jnp.int32) for k in stats}
     n_variants = 0
+    n_matmuls = sum(len(genotype.CROSS_STATS[s]) for s in stats)
     with timer.phase("gram"):
-        ref_stream = stream_to_device(source_ref, bv)
-        new_stream = stream_to_device(source_new, bv)
-        for (bn, mn), (br, mr) in zip(new_stream, ref_stream):
+        # Zip manually so a length mismatch is an ERROR, not a silent
+        # prefix (and without consulting n_variants up front — for
+        # VCF/filtered sources that property is a full extra parse).
+        it_new = iter(stream_to_device(source_new, bv))
+        it_ref = iter(stream_to_device(source_ref, bv))
+        while True:
+            nxt_new = next(it_new, None)
+            nxt_ref = next(it_ref, None)
+            if (nxt_new is None) != (nxt_ref is None):
+                short = "new" if nxt_new is None else "reference"
+                raise ValueError(
+                    f"the {short} cohort stream ended first — both "
+                    "cohorts must carry the same variant set (a silent "
+                    "prefix-zip would compute distances on partial data)"
+                )
+            if nxt_new is None:
+                break
+            (bn, mn), (br, mr) = nxt_new, nxt_ref
             if (mn.start, mn.stop) != (mr.start, mr.stop):
                 raise ValueError(
                     "new/reference streams diverged: new block "
@@ -166,14 +176,14 @@ def pcoa_project_job(
                     f"[{mn.start}, {mn.stop}) — not the same variant set"
                 )
             acc = _update_cross(acc, bn, br)
-            n_matmuls = sum(
-                len(genotype.CROSS_STATS[s]) for s in stats
-            )
             timer.add("gram_flops",
                       2.0 * a * n_ref * bn.shape[1] * n_matmuls)
             timer.add("ingest_bytes", bn.size + br.size)
             n_variants = mn.stop
         acc = hard_sync(acc)
+    # Same int32-exactness guard as the symmetric path (d1's increment
+    # bound is MAX_INCREMENT['ibs']); warns when counts may have wrapped.
+    R._check_int32_budget(metric, n_variants, 2)
     # One fused device step: finalize cross distances + Gower extension
     # + eigvec products; only the (A, k) coordinates come home.
     with timer.phase("eigh"):

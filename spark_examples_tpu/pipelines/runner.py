@@ -79,6 +79,16 @@ def _maybe_partitioned(cls, cfg: IngestConfig):
 
 def build_source(cfg: IngestConfig):
     """IngestConfig -> GenotypeSource (the reference's L2/L3 factory)."""
+    src = _build_raw_source(cfg)
+    if cfg.maf > 0.0 or cfg.max_missing < 1.0:
+        from spark_examples_tpu.ingest.filters import FilteredSource
+
+        return FilteredSource(src, maf=cfg.maf,
+                              max_missing=cfg.max_missing)
+    return src
+
+
+def _build_raw_source(cfg: IngestConfig):
     if cfg.source == "synthetic":
         return SyntheticSource(
             n_samples=cfg.n_samples,

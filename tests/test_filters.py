@@ -1,0 +1,93 @@
+"""Variant QC stream filter (--maf / --max-missing): mask semantics,
+re-chunking, contig boundaries, resume, and CLI wiring."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.ingest.filters import FilteredSource, qc_mask
+from spark_examples_tpu.ingest.source import ArraySource
+from tests.conftest import random_genotypes
+
+
+def _materialize(src, bv, start=0):
+    blocks = [b for b, _ in src.blocks(bv, start)]
+    return (np.concatenate(blocks, axis=1) if blocks
+            else np.empty((src.n_samples, 0), np.int8))
+
+
+def _expected(g, maf, max_missing):
+    return g[:, qc_mask(g, maf, max_missing)]
+
+
+def test_qc_mask_semantics():
+    g = np.array([
+        [0, 2, -1, 1, -1],
+        [0, 2, -1, 1, 0],
+        [0, 2, -1, 0, 0],
+        [0, 2, -1, 0, 0],
+    ], np.int8)
+    # col0: p=0 (monomorphic ref); col1: p=1 (monomorphic alt);
+    # col2: all missing; col3: p=0.25; col4: 1/4 missing, p=0
+    keep = qc_mask(g, maf=0.05, max_missing=0.5)
+    np.testing.assert_array_equal(keep, [False, False, False, True, False])
+    keep = qc_mask(g, maf=0.0, max_missing=0.3)
+    np.testing.assert_array_equal(keep, [True, True, False, True, True])
+
+
+@pytest.mark.parametrize("bv", [16, 64, 256])
+def test_filter_block_size_invariance(rng, bv):
+    g = random_genotypes(rng, n=20, v=700, missing_rate=0.3)
+    src = FilteredSource(ArraySource(g), maf=0.1, max_missing=0.25)
+    out = _materialize(src, bv)
+    np.testing.assert_array_equal(out, _expected(g, 0.1, 0.25))
+    # ordinals are contiguous over the filtered stream
+    metas = [m for _, m in src.blocks(bv)]
+    assert metas[0].start == 0
+    for a, b in zip(metas, metas[1:]):
+        assert b.start == a.stop
+    assert src.n_variants == out.shape[1]
+
+
+def test_filter_preserves_contig_boundaries(rng, tmp_path):
+    from spark_examples_tpu.ingest.plink import PlinkSource, write_plink
+
+    g = random_genotypes(rng, n=8, v=60, missing_rate=0.2)
+    prefix = str(tmp_path / "c")
+    write_plink(prefix, g, chroms=["1"] * 25 + ["2"] * 35,
+                positions=np.arange(60))
+    src = FilteredSource(PlinkSource(prefix), max_missing=0.3)
+    blocks = list(src.blocks(16))
+    for b, m in blocks:
+        assert m.contig in ("1", "2")
+        assert b.shape[1] == m.stop - m.start
+    # positions survive filtering and match the kept columns
+    keep = qc_mask(g, 0.0, 0.3)
+    kept_pos = np.arange(60)[keep]
+    got_pos = np.concatenate([m.positions for _, m in blocks])
+    np.testing.assert_array_equal(got_pos, kept_pos)
+    np.testing.assert_array_equal(_materialize(src, 16), g[:, keep])
+
+
+def test_filter_resume(rng):
+    g = random_genotypes(rng, n=10, v=500, missing_rate=0.2)
+    src = FilteredSource(ArraySource(g), maf=0.05)
+    full = list(src.blocks(64))
+    cursor = full[2][1].stop
+    resumed = list(src.blocks(64, cursor))
+    assert [m.start for _, m in resumed] == [m.start for _, m in full[3:]]
+    np.testing.assert_array_equal(resumed[0][0], full[3][0])
+
+
+def test_filter_pipeline_and_cli(rng, tmp_path, capsys):
+    from spark_examples_tpu.cli.main import main
+    from spark_examples_tpu.ingest.vcf import write_vcf
+
+    g = random_genotypes(rng, n=15, v=400, missing_rate=0.3)
+    path = str(tmp_path / "c.vcf")
+    write_vcf(path, g)
+    want = _expected(g, 0.1, 0.2)
+    assert main(["similarity", "--source", "vcf", "--path", path,
+                 "--maf", "0.1", "--max-missing", "0.2",
+                 "--block-variants", "64"]) == 0
+    cap = capsys.readouterr()
+    assert f"over {want.shape[1]} variants" in cap.out
