@@ -102,6 +102,36 @@ def test_project_rejects_mismatched_streams(rng, tmp_path):
         )
 
 
+def test_qc_pack_fit_project_chain(rng, tmp_path, capsys):
+    """The documented panel-QC workflow (the project command's own
+    recommendation): pack --maf into a filtered store, fit on it, then
+    project from the same store — self-projection reproduces the fitted
+    coordinates."""
+    from spark_examples_tpu.cli.main import main
+    from spark_examples_tpu.ingest.vcf import write_vcf
+
+    g = random_genotypes(rng, n=12, v=500, missing_rate=0.2)
+    vcf = str(tmp_path / "c.vcf")
+    write_vcf(vcf, g)
+    store = str(tmp_path / "store")
+    model = str(tmp_path / "m.npz")
+    fit_tsv, proj_tsv = str(tmp_path / "f.tsv"), str(tmp_path / "p.tsv")
+    assert main(["pack", "--source", "vcf", "--path", vcf, "--maf", "0.1",
+                 "--max-missing", "0.2", "--output-path", store,
+                 "--block-variants", "64"]) == 0
+    assert main(["pcoa", "--source", "packed", "--path", store,
+                 "--num-pc", "3", "--block-variants", "64",
+                 "--save-model", model, "--output-path", fit_tsv]) == 0
+    assert main(["project", "--source", "packed", "--path", store,
+                 "--ref-source", "packed", "--ref-path", store,
+                 "--model", model, "--block-variants", "64",
+                 "--output-path", proj_tsv]) == 0
+    fit = np.loadtxt(fit_tsv, skiprows=1, usecols=(1, 2, 3))
+    proj = np.loadtxt(proj_tsv, skiprows=1, usecols=(1, 2, 3))
+    np.testing.assert_allclose(proj, fit, atol=5e-3)
+    capsys.readouterr()
+
+
 def test_project_cli_flow(rng, tmp_path, capsys):
     """pcoa --save-model then project, through the real CLI."""
     from spark_examples_tpu.cli.main import main
