@@ -90,6 +90,32 @@ def test_hard_sync_forces_every_shard(mesh, monkeypatch):
     assert len(fetched) == 1
     assert float(fetched[0]) == float(np.arange(64.0).sum() + 3.0)
 
+    # Unregistered-dataclass results (PCoAResult etc.) are opaque leaves
+    # to tree_util — hard_sync must expand them or it barriers on
+    # NOTHING (the bug that made a dense eigh "finish" in 2 ms while its
+    # 371 ms drained into the next phase).
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Res:
+        coords: object
+        note: str = "x"
+
+    fetched.clear()
+    res = Res(coords=jax.numpy.arange(5.0))
+    assert profiling.hard_sync(res) is res
+    assert len(fetched) == 1
+    assert float(fetched[0]) == 10.0  # the coords really entered the sum
+
+    # containers INSIDE dataclass fields expand too (GramRun.acc is a
+    # dict of device arrays)
+    fetched.clear()
+    res = Res(coords={"a": jax.numpy.arange(3.0),
+                      "b": [jax.numpy.ones(2), "meta"]})
+    profiling.hard_sync(res)
+    assert len(fetched) == 1
+    assert float(fetched[0]) == 5.0  # 0+1+2 from a, 1+1 from b
+
 
 def test_tile2d_sharded_solve_matches_dense(rng, mesh):
     """The config-4 route: finalize -> center -> randomized eigh with
