@@ -35,7 +35,7 @@ def top_k_eigh(b: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     return vals, vecs
 
 
-def _subspace_iterate_impl(b, q, k: int, iters: int):
+def _subspace_iterate_impl(b, q, k: int, iters: int, select: str = "top"):
     def step(q, _):
         q, _ = jnp.linalg.qr(b @ q)
         return q, None
@@ -45,6 +45,16 @@ def _subspace_iterate_impl(b, q, k: int, iters: int):
     t = q.T @ (b @ q)
     t = 0.5 * (t + t.T)
     vals, s = jnp.linalg.eigh(t)
+    if select == "abs":
+        # Largest-|lambda| pairs — the PCA driver's ordering (power
+        # iteration amplifies |lambda|, so the tracked subspace already
+        # targets these; only the final selection differs from "top").
+        order = jnp.argsort(-jnp.abs(vals))[:k]
+        return vals[order], (q @ s)[:, order], q
+    if select != "top":  # static arg: free at trace time, and a typo
+        raise ValueError(  # must not silently pick the wrong spectrum
+            f"unknown select {select!r}; valid: top | abs"
+        )
     vals_k = vals[::-1][:k]
     vecs = (q @ s)[:, ::-1][:, :k]
     return vals_k, vecs, q
@@ -86,13 +96,14 @@ def coords_from_eigpairs(vals: jnp.ndarray, vecs: jnp.ndarray) -> jnp.ndarray:
     return vecs * jnp.sqrt(jnp.maximum(vals, 0.0))[None, :]
 
 
-@partial(jax.jit, static_argnames=("k", "oversample", "iters"))
+@partial(jax.jit, static_argnames=("k", "oversample", "iters", "select"))
 def randomized_eigh(
     b: jnp.ndarray,
     k: int,
     key: jax.Array,
     oversample: int = 16,
     iters: int = 4,
+    select: str = "top",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Randomized top-k eigenpairs of symmetric ``b``.
 
@@ -101,10 +112,11 @@ def randomized_eigh(
     only large-N operations are ``b @ q`` products — (N, N) x (N, k+p)
     matmuls that tile onto the MXU and shard cleanly over the mesh.
     Cold start of :func:`subspace_iterate` (iters + 1 power steps from
-    random probes).
+    random probes). ``select="abs"`` returns the largest-|lambda| pairs
+    instead of the largest-value ones (the PCA driver's ordering).
     """
     q = init_probes(key, b.shape[0], k + oversample, b.dtype)  # p clamped to N
-    vals, vecs, _ = _subspace_iterate_impl(b, q, k, iters + 1)
+    vals, vecs, _ = _subspace_iterate_impl(b, q, k, iters + 1, select)
     return vals, vecs
 
 

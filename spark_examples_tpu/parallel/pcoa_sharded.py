@@ -30,32 +30,44 @@ import jax
 import jax.numpy as jnp
 
 from spark_examples_tpu.core import meshes
+from spark_examples_tpu.models.pca import PCAResult
 from spark_examples_tpu.models.pcoa import PCoAResult
 from spark_examples_tpu.ops import distances
-from spark_examples_tpu.ops.centering import gower_center
+from spark_examples_tpu.ops.centering import center_matrix, gower_center
 from spark_examples_tpu.ops.eigh import coords_from_eigpairs, randomized_eigh
 from spark_examples_tpu.parallel.gram_sharded import GramPlan, _acc_shardings
 
 
 @lru_cache(maxsize=32)
-def _finalize_jit(plan: GramPlan, metric: str):
-    """acc (tile2d leaves) -> distance, kept tile2d."""
+def _finalize_field_jit(plan: GramPlan, metric: str, field: str):
+    """acc (tile2d leaves) -> one finalized matrix ("distance" for the
+    PCoA route, "similarity" for PCA), kept tile2d."""
     acc_sh = _acc_shardings(plan, metric)
     return jax.jit(
-        lambda acc: distances.finalize(acc, metric)["distance"],
+        lambda acc: distances.finalize(acc, metric)[field],
         in_shardings=(acc_sh,),
         out_shardings=plan.acc_sharding,
         donate_argnums=(0,),
     )
 
 
+def _center_sym(s):
+    """PCA centering: symmetrized J A J (models/pca._fit's form)."""
+    c = center_matrix.__wrapped__(s)
+    return 0.5 * (c + c.T)
+
+
+_CENTER_FN = {"gower": gower_center, "pca": _center_sym}
+
+
 @lru_cache(maxsize=32)
-def _center_jit(plan: GramPlan):
-    """distance (tile2d) -> Gower-centered B, kept tile2d. Row/col mean
-    subtraction is two sharded reductions (psum over one mesh axis
-    each); nothing widens."""
+def _center_jit(plan: GramPlan, kind: str = "gower"):
+    """N x N matrix (tile2d) -> centered matrix, kept tile2d. Row/col
+    mean subtraction is two sharded reductions (psum over one mesh axis
+    each); the PCA variant's symmetry-guard transpose is a mesh
+    transpose of the tile grid (all-to-all over ICI). Nothing widens."""
     return jax.jit(
-        gower_center,
+        _CENTER_FN[kind],
         in_shardings=(plan.acc_sharding,),
         out_shardings=plan.acc_sharding,
         donate_argnums=(0,),
@@ -63,32 +75,94 @@ def _center_jit(plan: GramPlan):
 
 
 @lru_cache(maxsize=32)
-def _eigh_jit(plan: GramPlan, k: int, oversample: int, iters: int):
-    """B (tile2d) -> (vals, vecs) replicated.
+def _eigh_jit(plan: GramPlan, k: int, oversample: int, iters: int,
+              select: str = "top", with_trace: bool = True):
+    """B (tile2d) -> (vals, vecs[, trace]) replicated.
 
     The algorithm is exactly ops.eigh.randomized_eigh — the only
     difference is the sharding contract: B stays tiled, the (N, k+p)
     subspace iterates replicated, and every B @ Q is a sharded matmul
     (local contraction + psum over mesh axis j). QR/eigh of the skinny
     (N, p)/(p, p) blocks run replicated — at 76k x 26 that is ~100
-    MFLOP, irrelevant next to the 2 N^2 p matmuls.
+    MFLOP, irrelevant next to the 2 N^2 p matmuls. ``select="abs"`` is
+    the PCA ordering; ``with_trace`` adds total inertia (computed inside
+    so ``b`` can be donated and freed).
     """
     repl = meshes.replicated(plan.mesh)
 
     def solve(b, key):
         vals, vecs = randomized_eigh.__wrapped__(
-            b, k, key, oversample=oversample, iters=iters
+            b, k, key, oversample=oversample, iters=iters, select=select
         )
-        # Total inertia (sum of all eigenvalues) for proportion-explained
-        # — computed here so `b` can be donated and freed.
-        return vals, vecs, jnp.trace(b)
+        if with_trace:
+            return vals, vecs, jnp.trace(b)
+        return vals, vecs
 
     return jax.jit(
         solve,
         in_shardings=(plan.acc_sharding, repl),
-        out_shardings=(repl, repl, repl),
+        out_shardings=(repl, repl, repl) if with_trace else (repl, repl),
         donate_argnums=(0,),
     )
+
+
+def _solve_sharded(plan, acc, metric, field, center_kind, k, key,
+                   oversample, iters, select, with_trace,
+                   check_shardings, timer):
+    """Shared stage choreography of both sharded routes: finalize ->
+    center -> randomized eig, every N x N input donated stage to stage
+    (per-device peak ~one tile per live stage) and tile-asserted at each
+    boundary. The two public entry points differ only in parameters."""
+    from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
+
+    if key is None:
+        key = jax.random.key(0)
+    if timer is None:
+        timer = PhaseTimer()
+    with timer.phase("finalize"):
+        mat = _finalize_field_jit(plan, metric, field)(acc)
+        if check_shardings:
+            assert_tiled(mat, plan, f"finalize {field}")
+        b = hard_sync(_center_jit(plan, center_kind)(mat))
+        del mat  # donated into b
+    if check_shardings:
+        assert_tiled(b, plan, f"{center_kind}-centered matrix")
+    with timer.phase("eigh"):
+        out = hard_sync(
+            _eigh_jit(plan, k, oversample, iters, select, with_trace)(
+                b, key
+            )
+        )
+    return out
+
+
+def pca_coords_sharded(
+    plan: GramPlan,
+    acc: dict,
+    metric: str = "shared-alt",
+    k: int = 10,
+    key: jax.Array | None = None,
+    oversample: int = 16,
+    iters: int = 6,
+    check_shardings: bool = True,
+    timer=None,
+) -> PCAResult:
+    """Raw tile2d accumulators -> PCA coordinates with no full N x N
+    leaf on any device — the flagship ``VariantsPcaDriver`` at the 76k
+    regime, where the host fallback (materialize N x N, dense eigh)
+    stops being possible. Mirrors models/pca.fit_pca stage for stage
+    (finalize similarity -> center+symmetrize -> top-|lambda| eig ->
+    coords = v * lambda); small-N parity with the dense route is pinned
+    by tests/test_parallel.py. ``acc`` is donated stage to stage, as in
+    :func:`pcoa_coords_sharded`.
+    """
+    vals, vecs = _solve_sharded(
+        plan, acc, metric, "similarity", "pca", k, key, oversample,
+        iters, select="abs", with_trace=False,
+        check_shardings=check_shardings, timer=timer,
+    )
+    coords = vecs * vals[None, :]  # projection C v = lambda v
+    return PCAResult(coords, vals)
 
 
 def assert_tiled(x: jax.Array, plan: GramPlan, what: str) -> None:
@@ -133,24 +207,11 @@ def pcoa_coords_sharded(
     of accumulating all of them; ``acc`` is consumed — callers must not
     reuse it afterwards.
     """
-    from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
-
-    if key is None:
-        key = jax.random.key(0)
-    if timer is None:
-        timer = PhaseTimer()
-    with timer.phase("finalize"):
-        dist = _finalize_jit(plan, metric)(acc)
-        if check_shardings:
-            assert_tiled(dist, plan, "finalize distance")
-        b = hard_sync(_center_jit(plan)(dist))
-        del dist  # donated into b
-    if check_shardings:
-        assert_tiled(b, plan, "gower-centered B")
-    with timer.phase("eigh"):
-        vals, vecs, trace = hard_sync(
-            _eigh_jit(plan, k, oversample, iters)(b, key)
-        )
+    vals, vecs, trace = _solve_sharded(
+        plan, acc, metric, "distance", "gower", k, key, oversample,
+        iters, select="top", with_trace=True,
+        check_shardings=check_shardings, timer=timer,
+    )
     coords = coords_from_eigpairs(vals, vecs)
     prop = jnp.maximum(vals, 0.0) / jnp.maximum(trace, 1e-30)
     return PCoAResult(coords, vals, prop)

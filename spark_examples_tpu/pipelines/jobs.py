@@ -231,11 +231,20 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
 
                 source = build_source(job.ingest)
         plan = runner.plan_for_job(job, source)
-        # PCA's centered-similarity eig is dense (fit_pca), which needs
-        # the full matrix on one device — tile2d-sharded plans fall back
-        # to the host route below.
-        if plan.mode != "tile2d":
-            grun = runner.run_gram(job, source, timer, plan=plan)
+        grun = runner.run_gram(job, source, timer, plan=plan)
+        if plan.mode == "tile2d":
+            # The 76k regime: similarity -> center -> top-|lambda| eig
+            # all tile2d-sharded (parallel/pcoa_sharded.py) — the host
+            # fallback would materialize the N x N matrix the tiling
+            # exists to avoid.
+            from spark_examples_tpu.parallel.pcoa_sharded import (
+                pca_coords_sharded,
+            )
+
+            res = pca_coords_sharded(plan, grun.acc, "shared-alt", k=k,
+                                     timer=timer)
+            method = "randomized"
+        else:
             with timer.phase("finalize"):
                 sim_dev = hard_sync(
                     runner.finalize_field(grun.acc, "shared-alt",
@@ -243,10 +252,11 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
                 )
             with timer.phase("eigh"):
                 res = hard_sync(fit_pca(sim_dev, k=k))
-            return _emit_coords(job, grun.sample_ids,
-                                np.asarray(res.coords),
-                                np.asarray(res.eigenvalues), timer,
-                                grun.n_variants, method="dense")
+            method = "dense"
+        return _emit_coords(job, grun.sample_ids,
+                            np.asarray(res.coords),
+                            np.asarray(res.eigenvalues), timer,
+                            grun.n_variants, method=method)
 
     sim = run_similarity(job, source=source)
     if job.compute.backend == "cpu-reference":

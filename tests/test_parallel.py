@@ -197,6 +197,76 @@ def test_pcoa_job_tile2d_route_matches_variant_route(rng):
     assert "eigh" in tiled.timer.phases and "gram" in tiled.timer.phases
 
 
+def test_pca_sharded_matches_dense(rng, mesh):
+    """The flagship PCA at the tile2d regime: finalize -> center ->
+    top-|lambda| eig fully sharded must match models/pca.fit_pca, with
+    the tile contract asserted at every N x N stage boundary."""
+    from spark_examples_tpu.models.pca import fit_pca
+    from spark_examples_tpu.ops import distances
+    from spark_examples_tpu.parallel import pcoa_sharded
+
+    n = 64
+    g = random_genotypes(rng, n=n, v=600, missing_rate=0.1)
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    acc = gram_sharded.init_sharded(plan, n, "shared-alt")
+    update = gram_sharded.make_update(plan, "shared-alt")
+    for s in range(0, 600, 120):
+        acc = update(acc, g[:, s : s + 120])
+    res = pcoa_sharded.pca_coords_sharded(plan, acc, "shared-alt", k=3,
+                                          iters=12, check_shardings=True)
+
+    dense_acc = gram.update(gram.init(n, "shared-alt"), g, "shared-alt")
+    sim = distances.finalize(dense_acc, "shared-alt")["similarity"]
+    want = fit_pca(np.asarray(sim), k=3)
+    # Eigenvalues agree to sub-percent (this cohort is unstructured so
+    # the spectrum is clustered — the hard case for subspace iteration);
+    # eigenVECTORS may rotate within a near-degenerate cluster, so the
+    # gap-independent correctness criterion is the residual: each
+    # returned (lambda, v) must be a genuine eigenpair of the DENSE
+    # centered matrix.
+    np.testing.assert_allclose(
+        np.asarray(res.eigenvalues), np.asarray(want.eigenvalues),
+        rtol=5e-3,
+    )
+    from spark_examples_tpu.ops.centering import center_matrix
+
+    c = np.asarray(center_matrix(np.asarray(sim, np.float32)))
+    c = 0.5 * (c + c.T)
+    vals = np.asarray(res.eigenvalues)
+    vecs = np.asarray(res.coords) / vals[None, :]  # coords = v * lambda
+    resid = np.linalg.norm(c @ vecs - vecs * vals[None, :], axis=0)
+    assert (resid / np.abs(vals) < 2e-2).all(), resid / np.abs(vals)
+
+
+def test_pca_job_tile2d_route_matches_variant_route(rng):
+    """variants_pca_job with gram_mode=tile2d takes the fully-sharded
+    PCA solve and must agree with the variant-mode dense route."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.pipelines import jobs
+
+    def run(mode):
+        job = JobConfig(
+            ingest=IngestConfig(source="synthetic", n_samples=48,
+                                n_variants=1500, block_variants=512, seed=9),
+            compute=ComputeConfig(num_pc=3, gram_mode=mode),
+        )
+        return jobs.variants_pca_job(job)
+
+    tiled = run("tile2d")
+    dense = run("variant")
+    np.testing.assert_allclose(
+        tiled.eigenvalues, dense.eigenvalues, rtol=5e-3
+    )
+    # atol-dominant: randomized-vs-dense coords agree to ~1 unit on
+    # components of magnitude ~140; near-zero entries make rtol alone
+    # meaningless
+    np.testing.assert_allclose(
+        np.abs(tiled.coords), np.abs(dense.coords), rtol=2e-2, atol=1.0
+    )
+
+
 def test_sharded_end_to_end_pcoa(rng, mesh):
     """Sharded accumulate -> finalize -> PCoA equals unsharded run."""
     from spark_examples_tpu.models.pcoa import fit_pcoa
