@@ -365,23 +365,33 @@ def bench_tile_rate() -> dict:
     N76, MESH = 76_000, (2, 4)
     tile = (N76 // MESH[0], N76 // MESH[1])
     n_eq = 26_880  # ~sqrt(tile area), multiple of 256
-    v = 2048
-    n_blocks = 8
+    v = 4096  # wide enough to amortize the int32 accumulator R/M/W
+    n_blocks = 4  # (wider would crowd HBM next to the 11.6 GB of accs)
     pieces = gram.PIECES_FOR_METRIC[METRIC]
 
-    g = jax.random.randint(jax.random.key(3), (n_eq, v), -1, 3, jnp.int8)
-    g = hard_sync(g)
-    update = jax.jit(
-        lambda acc, b: gram._update_impl(acc, b, pieces), donate_argnums=(0,)
-    )
-    acc = {k: jnp.zeros((n_eq, n_eq), jnp.int32) for k in pieces}
-    acc = update(acc, g)  # compile+warm
-    hard_sync(acc)
-    t0 = time.perf_counter()
-    for _ in range(n_blocks):
-        acc = update(acc, g)
-    hard_sync(acc)
-    dt = time.perf_counter() - t0
+    g_wide = hard_sync(jax.random.randint(
+        jax.random.key(3), (n_eq, v * n_blocks), -1, 3, jnp.int8
+    ))
+
+    @jax.jit
+    def accumulate(g_wide):
+        # One dispatch, data-dependent slices (distinct starts — a
+        # loop-invariant body would be strength-reduced by XLA and
+        # report impossible rates).
+        def body(acc, start):
+            blk = jax.lax.dynamic_slice(g_wide, (0, start), (n_eq, v))
+            return gram._update_impl(acc, blk, pieces), None
+
+        acc0 = {k: jnp.zeros((n_eq, n_eq), jnp.int32) for k in pieces}
+        acc, _ = jax.lax.scan(body, acc0, jnp.arange(n_blocks) * v)
+        return acc
+
+    hard_sync(accumulate(g_wide))  # compile+warm
+    dt = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        hard_sync(accumulate(g_wide))
+        dt = min(dt, time.perf_counter() - t0)
     flops = gram.flops_per_block(n_eq, v * n_blocks, METRIC)
     tflops = flops / dt / 1e12
     # Projected 8-chip accumulation for a 1M-variant exome-scale stream:

@@ -126,13 +126,17 @@ def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
 
 
 def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
-                 n_variants: int, method: str) -> CoordsOutput:
+                 n_variants: int, method: str,
+                 eigh_iters: int = 4) -> CoordsOutput:
     """Shared output tail of every PCoA route: solver-matched FLOP
-    credit, result assembly, optional TSV persistence."""
+    credit, result assembly, optional TSV persistence. ``eigh_iters``
+    must match the randomized solver's actual iteration count (the
+    sharded PCA route runs more than the default)."""
     # FLOP credit must match the solver actually run (the randomized
     # path's whole point is doing far fewer FLOPs than dense ~9n^3).
     timer.add("eigh_flops", eigh_flops(len(sample_ids), method=method,
-                                       k=job.compute.num_pc))
+                                       k=job.compute.num_pc,
+                                       iters=eigh_iters))
     out = CoordsOutput(sample_ids, np.asarray(coords), np.asarray(vals),
                        timer, n_variants)
     if job.output_path:
@@ -241,35 +245,33 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
                 pca_coords_sharded,
             )
 
+            iters = 6  # explicit so the FLOP credit below can't drift
             res = pca_coords_sharded(plan, grun.acc, "shared-alt", k=k,
-                                     timer=timer)
-            method = "randomized"
-        else:
-            with timer.phase("finalize"):
-                sim_dev = hard_sync(
-                    runner.finalize_field(grun.acc, "shared-alt",
-                                          "similarity")
-                )
-            with timer.phase("eigh"):
-                res = hard_sync(fit_pca(sim_dev, k=k))
-            method = "dense"
+                                     iters=iters, timer=timer)
+            return _emit_coords(job, grun.sample_ids,
+                                np.asarray(res.coords),
+                                np.asarray(res.eigenvalues), timer,
+                                grun.n_variants, method="randomized",
+                                eigh_iters=iters)  # honest FLOP credit
+        with timer.phase("finalize"):
+            sim_dev = hard_sync(
+                runner.finalize_field(grun.acc, "shared-alt",
+                                      "similarity")
+            )
+        with timer.phase("eigh"):
+            res = hard_sync(fit_pca(sim_dev, k=k))
         return _emit_coords(job, grun.sample_ids,
                             np.asarray(res.coords),
                             np.asarray(res.eigenvalues), timer,
-                            grun.n_variants, method=method)
+                            grun.n_variants, method="dense")
 
+    # cpu-reference backend only (the jax backend always returned above):
+    # the measured MLlib-route oracle.
     sim = run_similarity(job, source=source)
-    if job.compute.backend == "cpu-reference":
-        with sim.timer.phase("eigh"):
-            coords, vals = oracle.pca_mllib_route(
-                sim.similarity, k=k, return_values=True
-            )
-    else:
-        with sim.timer.phase("eigh"):
-            res = hard_sync(
-                fit_pca(sim.similarity.astype(np.float32), k=k)
-            )
-        coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
+    with sim.timer.phase("eigh"):
+        coords, vals = oracle.pca_mllib_route(
+            sim.similarity, k=k, return_values=True
+        )
     return _emit_coords(job, sim.sample_ids, coords, vals, sim.timer,
                         sim.n_variants, method="dense")
 
