@@ -159,6 +159,10 @@ def main(argv: list[str] | None = None) -> int:
 
     p_pca = sub.add_parser("pca", help="flagship variants-PCA driver")
     _add_common(p_pca)
+    p_pca.add_argument("--save-model", default=None,
+                       help="persist the fitted PCA embedding (.npz) so "
+                       "`project` can later place new samples into this "
+                       "coordinate space")
     # The PCA driver is defined on the shared-alt similarity (the
     # reference's VariantsPcaDriver counting); any other --metric would
     # be silently ignored, so reject it instead.

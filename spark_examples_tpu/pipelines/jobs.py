@@ -235,6 +235,15 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
 
                 source = build_source(job.ingest)
         plan = runner.plan_for_job(job, source)
+        if plan.mode == "tile2d" and job.model_path:
+            # Fail BEFORE streaming (projection needs the dense
+            # similarity's centering statistics, which the tile2d route
+            # never materializes).
+            raise ValueError(
+                "--save-model needs the dense similarity matrix for "
+                "the projection centering statistics; fit the model "
+                "with gram_mode=variant"
+            )
         grun = runner.run_gram(job, source, timer, plan=plan)
         if plan.mode == "tile2d":
             # The 76k regime: similarity -> center -> top-|lambda| eig
@@ -260,6 +269,12 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
             )
         with timer.phase("eigh"):
             res = hard_sync(fit_pca(sim_dev, k=k))
+        # sim_dev passed as-is: the helper's early return keeps the
+        # N x N matrix on device unless a model save actually needs it
+        # (the route's contract: only (N, k) projections come home).
+        _maybe_save_pca_model(job, sim_dev, np.asarray(res.coords),
+                              np.asarray(res.eigenvalues),
+                              grun.sample_ids)
         return _emit_coords(job, grun.sample_ids,
                             np.asarray(res.coords),
                             np.asarray(res.eigenvalues), timer,
@@ -272,8 +287,19 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
         coords, vals = oracle.pca_mllib_route(
             sim.similarity, k=k, return_values=True
         )
+    _maybe_save_pca_model(job, sim.similarity, coords, vals,
+                          sim.sample_ids)
     return _emit_coords(job, sim.sample_ids, coords, vals, sim.timer,
                         sim.n_variants, method="dense")
+
+
+def _maybe_save_pca_model(job, similarity, coords, vals, sample_ids):
+    if not job.model_path:
+        return  # before any np.asarray: no D2H unless actually saving
+    from spark_examples_tpu.pipelines.project import save_pca_model
+
+    save_pca_model(job.model_path, coords, vals, np.asarray(similarity),
+                   sample_ids)
 
 
 def _eigh_method(eigh_mode: str, n: int) -> str:

@@ -22,8 +22,11 @@ reference+new moves every axis. This module adds that second half:
    their fitted coordinates exactly (B V = V diag(lambda)), which is the
    invariant the tests pin.
 
-Supported metrics: the IBS family (``ibs``) — the distance the PCoA
-entrypoint family is defined on.
+Two model kinds are projectable: PCoA over ``ibs`` distances (the Gower
+extension above) and the flagship PCA over shared-alt similarities
+(``pca --save-model``; a new row's cross similarity is centered with
+the reference's column/grand means and projected onto V — training
+rows reproduce their fitted coordinates exactly, since C V = V Λ).
 """
 
 from __future__ import annotations
@@ -42,7 +45,14 @@ from spark_examples_tpu.pipelines import io as pio
 from spark_examples_tpu.pipelines import runner as R
 from spark_examples_tpu.pipelines.jobs import CoordsOutput
 
-CROSS_STATS_FOR_METRIC = {"ibs": ("m", "d1")}
+# (model kind, metric) -> cross statistics to stream. Keyed on BOTH: a
+# shared-alt PCoA model (valid to fit) is not projectable — gating on
+# metric alone would pass it through and crash after the expensive
+# cross-stream pass.
+PROJECTABLE = {
+    ("pcoa", "ibs"): ("m", "d1"),
+    ("pca", "shared-alt"): ("s",),
+}
 
 
 def save_model(
@@ -65,12 +75,44 @@ def save_model(
     d2 = np.asarray(distance, np.float64) ** 2
     np.savez(
         path,
+        kind=np.asarray("pcoa"),
         eigvecs=v,
         eigvals=vals[keep],
         d2_colmean=d2.mean(axis=0),
         d2_grand=np.float64(d2.mean()),
         sample_ids=np.asarray(sample_ids),
         metric=np.asarray(metric),
+    )
+
+
+def save_pca_model(
+    path: str,
+    coords: np.ndarray,
+    eigenvalues: np.ndarray,
+    similarity: np.ndarray,
+    sample_ids: list[str],
+) -> None:
+    """Persist a fitted PCA embedding (the flagship driver) for later
+    projection.
+
+    ``coords`` = V lambda (projection C v = lambda v), so V is
+    recovered by dividing out lambda; zero eigenvalues are dropped.
+    Projection of a new row needs the REFERENCE similarity's column
+    means and grand mean (the J ... J centering applied to cross rows).
+    """
+    vals = np.asarray(eigenvalues, np.float64)
+    keep = np.abs(vals) > 1e-12
+    v = np.asarray(coords, np.float64)[:, keep] / vals[keep]
+    s = np.asarray(similarity, np.float64)
+    np.savez(
+        path,
+        kind=np.asarray("pca"),
+        eigvecs=v,
+        eigvals=vals[keep],
+        s_colmean=s.mean(axis=0),
+        s_grand=np.float64(s.mean()),
+        sample_ids=np.asarray(sample_ids),
+        metric=np.asarray("shared-alt"),
     )
 
 
@@ -93,6 +135,21 @@ def _project(m, d1, d2_colmean, d2_grand, eigvecs, eigvals):
     return (b @ eigvecs) / jnp.sqrt(eigvals)[None, :]
 
 
+@partial(jax.jit, static_argnames=())
+def _project_pca(s, s_colmean, s_grand, eigvecs):
+    """PCA out-of-sample: center the cross similarity row with the
+    reference's column/grand means (J S J applied to a new row), then
+    project onto the eigenvectors — for a training row this reproduces
+    c_row @ V = lambda v_row = its fitted coordinates exactly."""
+    c = (
+        s.astype(jnp.float32)
+        - s.mean(axis=1, keepdims=True)
+        - s_colmean[None, :]
+        + s_grand
+    )
+    return c @ eigvecs
+
+
 def pcoa_project_job(
     job: JobConfig,
     model_path: str,
@@ -108,10 +165,11 @@ def pcoa_project_job(
     """
     with np.load(model_path, allow_pickle=False) as mdl:
         metric = str(mdl["metric"])
-        if metric not in CROSS_STATS_FOR_METRIC:
+        kind = str(mdl["kind"]) if "kind" in mdl else "pcoa"
+        if (kind, metric) not in PROJECTABLE:
             raise ValueError(
-                f"model metric {metric!r} is not projectable "
-                f"(supported: {sorted(CROSS_STATS_FOR_METRIC)})"
+                f"model (kind={kind!r}, metric={metric!r}) is not "
+                f"projectable (supported: {sorted(PROJECTABLE)})"
             )
         n_ref = mdl["eigvecs"].shape[0]
         model_ids = [str(s) for s in mdl["sample_ids"]]
@@ -130,11 +188,19 @@ def pcoa_project_job(
             )
         eigvecs = jnp.asarray(mdl["eigvecs"], jnp.float32)
         eigvals = jnp.asarray(mdl["eigvals"], jnp.float32)
-        d2_colmean = jnp.asarray(mdl["d2_colmean"], jnp.float32)
-        d2_grand = jnp.float32(mdl["d2_grand"])
+        if kind == "pca":
+            center_stats = (
+                jnp.asarray(mdl["s_colmean"], jnp.float32),
+                jnp.float32(mdl["s_grand"]),
+            )
+        else:
+            center_stats = (
+                jnp.asarray(mdl["d2_colmean"], jnp.float32),
+                jnp.float32(mdl["d2_grand"]),
+            )
 
     timer = PhaseTimer()
-    stats = CROSS_STATS_FOR_METRIC[metric]
+    stats = PROJECTABLE[(kind, metric)]
     a = source_new.n_samples
     bv = job.ingest.block_variants
     acc = {k: jnp.zeros((a, n_ref), jnp.int32) for k in stats}
@@ -184,12 +250,18 @@ def pcoa_project_job(
     # Same int32-exactness guard as the symmetric path (d1's increment
     # bound is MAX_INCREMENT['ibs']); warns when counts may have wrapped.
     R._check_int32_budget(metric, n_variants, 2)
-    # One fused device step: finalize cross distances + Gower extension
-    # + eigvec products; only the (A, k) coordinates come home.
+    # One fused device step: finalize cross statistics + out-of-sample
+    # centering + eigvec products; only the (A, k) coordinates come home.
     with timer.phase("eigh"):
-        coords = np.asarray(hard_sync(_project(
-            acc["m"], acc["d1"], d2_colmean, d2_grand, eigvecs, eigvals
-        )))
+        if kind == "pca":
+            coords = np.asarray(hard_sync(_project_pca(
+                acc["s"], center_stats[0], center_stats[1], eigvecs
+            )))
+        else:
+            coords = np.asarray(hard_sync(_project(
+                acc["m"], acc["d1"], center_stats[0], center_stats[1],
+                eigvecs, eigvals
+            )))
     out = CoordsOutput(source_new.sample_ids, coords,
                        np.asarray(eigvals), timer, n_variants)
     if job.output_path:

@@ -102,6 +102,74 @@ def test_project_rejects_mismatched_streams(rng, tmp_path):
         )
 
 
+def test_pca_project_training_samples_is_exact(rng, tmp_path):
+    """The flagship PCA driver's projection: c_row @ V = lambda v_row,
+    so pushing the panel's own samples through reproduces their fitted
+    PC coordinates."""
+    from spark_examples_tpu.pipelines.jobs import variants_pca_job
+
+    g = random_genotypes(rng, n=20, v=500, missing_rate=0.1)
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=128),
+        compute=ComputeConfig(num_pc=4),
+        model_path=model,
+    )
+    fitted = variants_pca_job(job, source=ArraySource(g))
+    out = pcoa_project_job(
+        job.replace(model_path=None), model_path=model,
+        source_new=ArraySource(g), source_ref=ArraySource(g),
+    )
+    k = out.coords.shape[1]
+    np.testing.assert_allclose(
+        out.coords, fitted.coords[:, :k], atol=2e-2
+    )
+
+
+def test_pca_project_places_heldout_by_ancestry(rng, tmp_path):
+    from spark_examples_tpu.pipelines.jobs import variants_pca_job
+
+    g, labels = _cohort(rng, n=90, v=4000)
+    ref, new = g[:60], g[60:]
+    lr, ln = labels[:60], labels[60:]
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=512),
+        compute=ComputeConfig(num_pc=3),
+        model_path=model,
+    )
+    fitted = variants_pca_job(job, source=ArraySource(ref))
+    out = pcoa_project_job(
+        job.replace(model_path=None), model_path=model,
+        source_new=ArraySource(new), source_ref=ArraySource(ref),
+    )
+    cents = np.stack(
+        [fitted.coords[lr == c, :2].mean(0) for c in range(3)]
+    )
+    for i in range(len(ln)):
+        d = np.linalg.norm(out.coords[i, :2] - cents, axis=1)
+        assert d.argmin() == ln[i]
+
+
+def test_shared_alt_pcoa_model_is_rejected_up_front(rng, tmp_path):
+    """A shared-alt PCoA model is valid to FIT but not projectable; the
+    gate must key on (kind, metric) and fail before streaming — metric
+    alone would pass it and crash after the expensive cross pass."""
+    g = random_genotypes(rng, n=10, v=256)
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=64),
+        compute=ComputeConfig(metric="shared-alt", num_pc=3),
+        model_path=model,
+    )
+    pcoa_job(job, source=ArraySource(g))
+    with pytest.raises(ValueError, match="not.*projectable"):
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(g), source_ref=ArraySource(g),
+        )
+
+
 def test_qc_pack_fit_project_chain(rng, tmp_path, capsys):
     """The documented panel-QC workflow (the project command's own
     recommendation): pack --maf into a filtered store, fit on it, then
