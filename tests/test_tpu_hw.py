@@ -44,11 +44,19 @@ print(json.dumps({
 """
 
 
-def _run_on_hw(script: str, timeout: int = 420) -> dict:
+def _run_on_hw(script: str, timeout: int = 420, strict: bool = False) -> dict:
+    """``strict``: a nonzero exit from the child is a test FAILURE, not
+    a skip — for gates where the crash IS the regression (the script
+    must print its own skip JSON for platform-unavailable cases before
+    entering the guarded section). Timeouts still skip either way: on a
+    tunneled dev chip a stall is ambiguous."""
     env = dict(os.environ)
     # Undo anything the parent test session forced; let the ambient
-    # platform (axon TPU here, CPU elsewhere) win in the child.
+    # platform (axon TPU here, CPU elsewhere) win in the child. This
+    # image's sitecustomize happens to override JAX_PLATFORMS anyway,
+    # but don't rely on that.
     env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     try:
         proc = subprocess.run(
@@ -58,6 +66,12 @@ def _run_on_hw(script: str, timeout: int = 420) -> dict:
     except subprocess.TimeoutExpired:
         pytest.skip("hardware subprocess timed out (tunnel stall?)")
     if proc.returncode != 0:
+        if strict:
+            pytest.fail(
+                "hardware subprocess crashed (the crash IS the "
+                "regression for this gate): "
+                + proc.stderr.strip()[-800:]
+            )
         pytest.skip(
             "TPU platform unavailable/unsupported for this kernel: "
             + proc.stderr.strip()[-800:]
@@ -71,3 +85,70 @@ def test_pallas_braycurtis_compiles_on_tpu():
         pytest.skip(out["skip"])
     assert out["backend"] != "cpu"
     assert out["max_err"] < 1e-4, out
+
+
+_PERF_SCRIPT = r"""
+import json, sys, time
+
+# Platform-init guard: anything failing in here is "hardware
+# unavailable" (skip); anything failing AFTER it is a real lowering
+# regression and must crash the subprocess (strict mode fails the test).
+try:
+    import jax
+
+    if jax.default_backend() == "cpu":
+        print(json.dumps({"skip": "no accelerator platform available"}))
+        sys.exit(0)
+    jax.numpy.zeros(8).block_until_ready()  # platform truly usable
+except Exception as e:  # noqa: BLE001 - any init failure = skip
+    print(json.dumps({"skip": f"platform init failed: {e!r}"}))
+    sys.exit(0)
+
+import jax.numpy as jnp
+from spark_examples_tpu.core.profiling import hard_sync
+from spark_examples_tpu.ops import gram
+
+# Small staged-shaped gram: one compiled scan over data-dependent
+# slices (see bench.py staged_run). Shapes kept modest so the test is
+# quick even over a slow dev tunnel.
+N, V_BLK, N_BLOCKS = 2504, 32768, 4
+pieces = gram.PIECES_FOR_METRIC["ibs"]
+g = hard_sync(jax.random.randint(
+    jax.random.key(0), (N, V_BLK * N_BLOCKS), -1, 3, jnp.int8
+))
+
+@jax.jit
+def accumulate(g):
+    def body(acc, start):
+        blk = jax.lax.dynamic_slice(g, (0, start), (N, V_BLK))
+        return gram._update_impl(acc, blk, pieces), None
+    acc0 = {k: jnp.zeros((N, N), jnp.int32) for k in pieces}
+    acc, _ = jax.lax.scan(body, acc0, jnp.arange(N_BLOCKS) * V_BLK)
+    return acc
+
+hard_sync(accumulate(g))  # compile+warm
+best = 1e9
+for _ in range(3):
+    t0 = time.perf_counter()
+    hard_sync(accumulate(g))
+    best = min(best, time.perf_counter() - t0)
+flops = gram.flops_per_block(N, V_BLK * N_BLOCKS, "ibs")
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "tflops": flops / best / 1e12,
+    "wall_ms": best * 1e3,
+}))
+"""
+
+
+def test_gram_throughput_floor_on_tpu():
+    """Regression gate for the int8 gram lowering: the staged update
+    must clear a conservative throughput floor on real hardware
+    (measured 150-280 TFLOP/s across sessions; the floor leaves room
+    for barrier-RTT variance on slow dev tunnels, but catches
+    order-of-magnitude lowering regressions — e.g. the MXU path
+    silently degrading to VPU or f32)."""
+    out = _run_on_hw(_PERF_SCRIPT, strict=True)
+    if "skip" in out:
+        pytest.skip(out["skip"])
+    assert out["tflops"] > 30.0, out
