@@ -173,6 +173,11 @@ def main(argv: list[str] | None = None) -> int:
     _add_common(p_sv)
     p_sv.add_argument("--positions", nargs="*", type=int, default=None)
 
+    p_ss = sub.add_parser("sample-stats",
+                          help="per-sample QC: call rate and "
+                          "heterozygosity over one streaming pass")
+    _add_common(p_ss)
+
     p_proj = sub.add_parser(
         "project",
         help="place NEW samples into a fitted reference PCoA space "
@@ -253,6 +258,33 @@ def main(argv: list[str] | None = None) -> int:
         return _dispatch(args, parser, job, J, build_source)
 
 
+_PREVIEW_ROWS = 50
+
+
+def _emit_table(job, header: str, lines: list[str], noun: str,
+                preview: list[str] | None = None) -> None:
+    """Shared table-output protocol of the search/stats tiers: full TSV
+    to ``--output-path`` (if set), up to ``_PREVIEW_ROWS`` console rows
+    (``preview`` — a pretty per-row rendering — or the TSV itself with
+    its header), and a '... N more' tail pointing at the file."""
+    import os
+
+    if job.output_path:
+        os.makedirs(os.path.dirname(job.output_path) or ".", exist_ok=True)
+        with open(job.output_path, "w") as f:
+            f.write(header)
+            f.writelines(lines)
+    shown = preview if preview is not None else lines
+    if preview is None:
+        sys.stdout.write(header)
+    sys.stdout.writelines(shown[:_PREVIEW_ROWS])
+    if len(shown) > _PREVIEW_ROWS:
+        tail = f"... {len(shown) - _PREVIEW_ROWS} more {noun}"
+        if job.output_path:
+            tail += f" (full table in {job.output_path})"
+        print(tail)
+
+
 def _dispatch(args, parser, job, J, build_source) -> int:
     if args.command == "similarity":
         res = J.similarity_matrix_job(job)
@@ -303,29 +335,40 @@ def _dispatch(args, parser, job, J, build_source) -> int:
         src = build_source(job.ingest)
         positions = set(args.positions) if args.positions else None
         counts = genotype_histogram(src, job.ingest.block_variants, positions)
-        if job.output_path:  # full results, not just the console preview
-            import os as _os
-
-            _os.makedirs(_os.path.dirname(job.output_path) or ".", exist_ok=True)
-            with open(job.output_path, "w") as f:
-                f.write("contig\tposition\thom_ref\thet\thom_alt\tmissing\taf\n")
-                for c in counts:
-                    f.write(
-                        f"{c.contig or '?'}\t{c.position}\t{c.hom_ref}\t"
-                        f"{c.het}\t{c.hom_alt}\t{c.missing}\t"
-                        f"{c.allele_freq:.6f}\n"
-                    )
-        for c in counts[:50]:
-            print(
+        _emit_table(
+            job,
+            header="contig\tposition\thom_ref\thet\thom_alt\tmissing\taf\n",
+            lines=[
+                f"{c.contig or '?'}\t{c.position}\t{c.hom_ref}\t"
+                f"{c.het}\t{c.hom_alt}\t{c.missing}\t"
+                f"{c.allele_freq:.6f}\n"
+                for c in counts
+            ],
+            noun="variants",
+            preview=[
                 f"{c.contig or '?'}:{c.position}\t0/0={c.hom_ref}\t"
                 f"0/1={c.het}\t1/1={c.hom_alt}\t./.={c.missing}\t"
-                f"af={c.allele_freq:.4f}"
-            )
-        if len(counts) > 50:
-            tail = f"... {len(counts) - 50} more variants"
-            if job.output_path:
-                tail += f" (full table in {job.output_path})"
-            print(tail)
+                f"af={c.allele_freq:.4f}\n"
+                for c in counts
+            ],
+        )
+        return 0
+    elif args.command == "sample-stats":
+        from spark_examples_tpu.pipelines.examples import sample_stats
+
+        stats = sample_stats(build_source(job.ingest),
+                             job.ingest.block_variants)
+        _emit_table(
+            job,
+            header=("sample\tn_called\tcall_rate\tn_het\thet_rate\t"
+                    "n_hom_alt\n"),
+            lines=[
+                f"{s.sample_id}\t{s.n_called}\t{s.call_rate:.6f}\t"
+                f"{s.n_het}\t{s.het_rate:.6f}\t{s.n_hom_alt}\n"
+                for s in stats
+            ],
+            noun="samples",
+        )
         return 0
     elif args.command == "project":
         import dataclasses as _dc

@@ -47,6 +47,61 @@ class VariantCounts:
         return (self.het + 2 * self.hom_alt) / (2 * called) if called else 0.0
 
 
+@jax.jit
+def _block_sample_counts(block: jnp.ndarray) -> jnp.ndarray:
+    """(N, v) int8 dosages -> (N, 3) per-sample counts of
+    [called, het, hom-alt] over the block's variants."""
+    return jnp.stack(
+        [
+            (block >= 0).sum(axis=1),
+            (block == 1).sum(axis=1),
+            (block == 2).sum(axis=1),
+        ],
+        axis=1,
+    )
+
+
+@dataclass
+class SampleStats:
+    sample_id: str
+    n_variants: int
+    n_called: int
+    n_het: int
+    n_hom_alt: int
+
+    @property
+    def call_rate(self) -> float:
+        return self.n_called / self.n_variants if self.n_variants else 0.0
+
+    @property
+    def het_rate(self) -> float:
+        """Heterozygosity over CALLED genotypes — the standard per-sample
+        QC statistic (outliers flag contamination or inbreeding)."""
+        return self.n_het / self.n_called if self.n_called else 0.0
+
+
+def sample_stats(source, block_variants: int = 8192) -> list[SampleStats]:
+    """Per-sample QC statistics over one streaming pass: call rate and
+    heterozygosity (the cohort-side complement of the per-variant
+    ``genotype_histogram`` tier). The accumulator is an (N, 3) int32
+    vector resident on device; blocks ride the same ingest machinery as
+    every other pipeline."""
+    acc = None
+    n_variants = 0
+    for block, meta in source.blocks(block_variants):
+        counts = _block_sample_counts(block)
+        acc = counts if acc is None else acc + counts
+        n_variants = meta.stop
+    if acc is None:
+        return []
+    a = np.asarray(acc)
+    return [
+        SampleStats(sid, n_variants, int(a[i, 0]), int(a[i, 1]),
+                    int(a[i, 2]))
+        for i, sid in enumerate(source.sample_ids)
+    ]
+
+
 def genotype_histogram(
     source,
     block_variants: int = 8192,

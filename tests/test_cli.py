@@ -124,3 +124,13 @@ def test_cli_pack_then_pcoa(tmp_path, capsys):
     a = np.loadtxt(from_store, skiprows=1, usecols=(1, 2, 3))
     b = np.loadtxt(from_vcf, skiprows=1, usecols=(1, 2, 3))
     np.testing.assert_allclose(np.abs(a), np.abs(b), atol=1e-5)
+
+
+def test_cli_sample_stats(tmp_path, capsys):
+    out = str(tmp_path / "stats.tsv")
+    cap = _run(capsys, "sample-stats", *BASE, "--output-path", out)
+    assert cap.out.startswith("sample\tn_called")
+    rows = open(out).read().strip().splitlines()
+    assert len(rows) == 25  # header + 24 samples
+    cols = rows[1].split("\t")
+    assert len(cols) == 6 and 0.0 <= float(cols[2]) <= 1.0

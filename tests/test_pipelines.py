@@ -196,3 +196,21 @@ def test_genotype_histogram(rng):
     # an EMPTY position set matches nothing (None means full scan) —
     # truthiness would silently flip it into a complete scan
     assert genotype_histogram(src, block_variants=32, positions=set()) == []
+
+
+def test_sample_stats(rng):
+    from spark_examples_tpu.pipelines.examples import sample_stats
+
+    g = random_genotypes(rng, 12, 200, missing_rate=0.25)
+    stats = sample_stats(ArraySource(g), block_variants=64)
+    assert len(stats) == 12
+    for i, s in enumerate(stats):
+        row = g[i]
+        assert s.n_variants == 200
+        assert s.n_called == (row >= 0).sum()
+        assert s.n_het == (row == 1).sum()
+        assert s.n_hom_alt == (row == 2).sum()
+        assert s.call_rate == pytest.approx(s.n_called / 200)
+        assert s.het_rate == pytest.approx(
+            s.n_het / s.n_called if s.n_called else 0.0
+        )
