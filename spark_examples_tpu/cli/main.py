@@ -53,6 +53,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "this (QC stream filter)")
     g.add_argument("--max-missing", type=float, default=1.0,
                    help="drop variants with missing-call rate above this")
+    g.add_argument("--ld-prune-r2", type=float, default=0.0,
+                   help="LD-prune: drop variants whose within-window r^2 "
+                   "against a kept variant exceeds this (0 = off; the "
+                   "PLINK --indep-pairwise analogue)")
+    g.add_argument("--ld-window", type=int, default=256,
+                   help="LD pruning window (variant count)")
+    g.add_argument("--ld-carry", type=int, default=0,
+                   help="kept variants carried across window boundaries "
+                   "(0 = auto: window/4)")
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
@@ -110,6 +119,9 @@ def _job_from_args(args) -> JobConfig:
             ingest_workers=args.ingest_workers,
             maf=args.maf,
             max_missing=args.max_missing,
+            ld_r2=args.ld_prune_r2,
+            ld_window=args.ld_window,
+            ld_carry=args.ld_carry,
         ),
         compute=ComputeConfig(
             backend=args.backend,
@@ -378,14 +390,15 @@ def _dispatch(args, parser, job, J, build_source) -> int:
         if not args.ref_path and args.ref_source != "synthetic":
             parser.error("project requires --ref-path (the panel "
                          "genotypes the model was fitted on)")
-        if args.maf > 0.0 or args.max_missing < 1.0:
+        if args.maf > 0.0 or args.max_missing < 1.0 or args.ld_prune_r2 > 0.0:
             parser.error(
-                "--maf/--max-missing cannot apply during project: the "
-                "QC mask is data-dependent, so each cohort would keep a "
-                "DIFFERENT variant subset and cross-distances would mix "
-                "misaligned variants. QC-filter the panel once (pack "
-                "--maf ... into a store), fit the model on that store, "
-                "and supply a new cohort genotyped at the same sites"
+                "--maf/--max-missing/--ld-prune-r2 cannot apply during "
+                "project: these masks are data-dependent, so each cohort "
+                "would keep a DIFFERENT variant subset and cross-"
+                "statistics would mix misaligned variants. Filter/prune "
+                "the panel once (pack --maf/--ld-prune-r2 ... into a "
+                "store), fit the model on that store, and supply a new "
+                "cohort genotyped at the same sites"
             )
         ref_cfg = _dc.replace(job.ingest, source=args.ref_source,
                               path=args.ref_path)

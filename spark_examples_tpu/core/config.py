@@ -71,6 +71,12 @@ class IngestConfig:
     # no-ops.
     maf: float = 0.0
     max_missing: float = 1.0
+    # LD pruning (ingest/ldprune.py, PLINK --indep-pairwise analogue):
+    # greedily drop variants whose within-window r^2 against a kept
+    # variant exceeds ld_r2 (0 = off). Applied AFTER the QC filter.
+    ld_r2: float = 0.0
+    ld_window: int = 256
+    ld_carry: int = 0  # 0 = auto (window // 4)
 
 
 @dataclass

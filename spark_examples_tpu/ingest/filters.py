@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from spark_examples_tpu.ingest.source import BlockMeta
+from spark_examples_tpu.ingest.source import rechunk
 
 
 def qc_mask(block: np.ndarray, maf: float, max_missing: float) -> np.ndarray:
@@ -71,8 +71,8 @@ class FilteredSource:
     @property
     def n_variants(self) -> int:
         """Kept-variant count — a full pass over the inner source
-        (lazy, cached; the streaming jobs count from meta.stop and
-        never call this)."""
+        (lazy; also cached as a side effect of any completed streaming
+        pass, so jobs that already streamed don't pay a second one)."""
         if self._n_variants is None:
             count = 0
             for block, _ in self.inner.blocks(16384):
@@ -80,67 +80,24 @@ class FilteredSource:
             self._n_variants = count
         return self._n_variants
 
-    def blocks(self, block_variants: int, start_variant: int = 0):
-        cols: list[np.ndarray] = []
-        pos: list[np.ndarray] = []
-        cur_contig: str | None = None
-        idx = 0
-        emitted = 0
-
-        def flush():
-            nonlocal cols, pos, idx, emitted
-            block = np.concatenate(cols, axis=1)
-            positions = (
-                np.concatenate(pos) if all(p is not None for p in pos)
-                else None
-            )
-            meta = BlockMeta(idx, emitted, emitted + block.shape[1],
-                             cur_contig, positions)
-            emitted += block.shape[1]
-            idx += 1
-            cols, pos = [], []
-            return block, meta
-
-        for block, meta in self.inner.blocks(block_variants):
+    def _filtered(self):
+        for block, meta in self.inner.blocks(16384):
             keep = qc_mask(block, self.maf, self.max_missing)
-            if cols and meta.contig != cur_contig:
-                yield from self._emit(flush, start_variant)
-            cur_contig = meta.contig
-            if not keep.any():
-                continue
-            kept = np.ascontiguousarray(block[:, keep])
-            cols.append(kept)
-            pos.append(
-                np.asarray(meta.positions)[keep]
-                if meta.positions is not None else None
+            yield (
+                np.ascontiguousarray(block[:, keep]),
+                (np.asarray(meta.positions)[keep]
+                 if meta.positions is not None else None),
+                meta.contig,
             )
-            # Emit steady-width blocks as soon as enough columns buffer.
-            while sum(c.shape[1] for c in cols) >= block_variants:
-                buf = np.concatenate(cols, axis=1)
-                bp = (
-                    np.concatenate(pos)
-                    if all(p is not None for p in pos) else None
-                )
-                head, tail = buf[:, :block_variants], buf[:, block_variants:]
-                cols = [np.ascontiguousarray(tail)] if tail.shape[1] else []
-                if bp is not None:
-                    hp, tp = bp[:block_variants], bp[block_variants:]
-                    pos = [tp] if tail.shape[1] else []
-                else:
-                    hp = None
-                    pos = [None] if tail.shape[1] else []
-                meta_out = BlockMeta(idx, emitted,
-                                     emitted + block_variants,
-                                     cur_contig, hp)
-                emitted += block_variants
-                idx += 1
-                if meta_out.start >= start_variant:
-                    yield np.ascontiguousarray(head), meta_out
-        if cols:
-            yield from self._emit(flush, start_variant)
 
-    @staticmethod
-    def _emit(flush, start_variant):
-        block, meta = flush()
-        if meta.start >= start_variant:
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        emitted = 0
+        for block, meta in rechunk(self._filtered(), block_variants,
+                                   start_variant):
+            emitted = meta.stop
             yield block, meta
+        if start_variant == 0:
+            # A completed full pass has counted the kept set — cache it
+            # so a later .n_variants doesn't re-stream (VcfSource makes
+            # the same promise).
+            self._n_variants = emitted

@@ -1,0 +1,167 @@
+"""LD pruning as a stream transform (``--ld-prune-r2``) — the
+``--indep-pairwise`` step of PLINK-family workflows.
+
+Nearby variants are correlated (linkage disequilibrium); PCA/kinship
+over unpruned data overweights long LD blocks, so the standard pipeline
+prunes until no within-window pair exceeds an r² threshold. The
+TPU-native shape: window the stream (the shared ``rechunk`` machinery,
+window-sized blocks, chromosome-flush), compute the squared correlation
+on device — ONE (W, N) x (N, W) matmul of per-variant standardized
+dosages (missing mean-imputed, the field's usual approximation to
+pairwise-complete r²) at a FIXED padded shape of ``carry + window``
+columns, so XLA compiles exactly once regardless of ragged windows —
+and run the greedy keep-scan on the host (an O(W) loop over a W²
+matrix already in hand). Kept columns re-chunk into steady blocks.
+
+Window handling: non-overlapping windows with the last ``carry`` KEPT
+variants carried into the next window's comparison set, so pairs
+spanning a boundary within ``carry`` variants are still checked —
+pairs further apart than a window are not (same spirit as PLINK's
+sliding step; documented approximation). LD context resets at
+chromosome boundaries (LD does not span them). Ordinals index the
+pruned stream; the prune is deterministic for a fixed
+source+parameters, so resume cursors stay valid.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_examples_tpu.ingest.source import rechunk
+
+
+@partial(jax.jit, static_argnames=("w",))
+def _window_r2(x: jnp.ndarray, w: int) -> jnp.ndarray:
+    """(N, w) int8 dosages -> (w, w) squared correlation of variants.
+
+    Missing calls are mean-imputed per variant (contributing zero after
+    centering); zero-variance variants — including zero PAD columns the
+    caller appends to keep this shape static — get r = 0 against
+    everything, which the greedy scan treats as "no LD" (pad columns
+    are sliced away before any decision).
+    """
+    valid = (x >= 0)
+    v = valid.astype(jnp.float32)
+    y = jnp.where(valid, x, 0).astype(jnp.float32)
+    cnt = jnp.maximum(v.sum(axis=0), 1.0)
+    mean = y.sum(axis=0) / cnt
+    z = jnp.where(valid, y - mean[None, :], 0.0)
+    cov = z.T @ z
+    var = jnp.diagonal(cov)
+    denom = jnp.sqrt(jnp.outer(var, var))
+    r = jnp.where(denom > 1e-12, cov / denom, 0.0)
+    return r * r
+
+
+def _greedy_keep(r2: np.ndarray, base: int, thresh: float) -> np.ndarray:
+    """Greedy scan: keep variant j iff its r² with every PREVIOUSLY
+    KEPT variant (including the ``base`` carried-in columns, which are
+    immutable) stays <= thresh. Returns the keep mask for columns
+    base..W (the carried columns are not re-decided)."""
+    w = r2.shape[0]
+    kept = list(range(base))
+    keep = np.zeros(w - base, bool)
+    for j in range(base, w):
+        if not kept or (r2[j, kept] <= thresh).all():
+            keep[j - base] = True
+            kept.append(j)
+    return keep
+
+
+@dataclass
+class LdPruneSource:
+    """LD-pruned view of any GenotypeSource."""
+
+    inner: object
+    r2: float = 0.2
+    window: int = 256
+    carry: int = 64
+    _n_variants: int | None = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if not 0.0 < self.r2 <= 1.0:
+            raise ValueError(f"r2 threshold must be in (0, 1], got {self.r2}")
+        if not 1 <= self.carry < self.window:
+            # carry=0 would make the negative tail-slice below grab the
+            # WHOLE history; negatives likewise — reject both loudly.
+            raise ValueError(
+                f"carry must be in [1, window), got carry={self.carry} "
+                f"window={self.window}"
+            )
+
+    @property
+    def sample_ids(self) -> list[str]:
+        return self.inner.sample_ids
+
+    @property
+    def n_samples(self) -> int:
+        return self.inner.n_samples
+
+    @property
+    def n_variants(self) -> int:
+        """Kept count — a full pruning pass (lazy; also cached by any
+        completed streaming pass, so jobs that already streamed don't
+        prune the cohort a second time)."""
+        if self._n_variants is None:
+            self._n_variants = sum(
+                b.shape[1] for b, _ in self.blocks(16384)
+            )
+        return self._n_variants
+
+    def _pruned_windows(self):
+        """Yield (kept_block, positions, contig) per window, carrying
+        kept-variant context within each contig. Every device call pads
+        to (N, carry + window) so XLA compiles the r² matmul once."""
+        n = self.inner.n_samples
+        wpad = self.carry + self.window
+        ctx: np.ndarray | None = None  # (N, <=carry) kept tail
+        ctx_contig: str | None = None
+
+        def pieces():
+            for block, meta in self.inner.blocks(self.window):
+                yield (
+                    block,
+                    (np.asarray(meta.positions)
+                     if meta.positions is not None else None),
+                    meta.contig,
+                )
+
+        for cols, meta in rechunk(pieces(), self.window):
+            if ctx_contig != meta.contig:
+                ctx = None  # LD does not span chromosomes
+            base = 0 if ctx is None else ctx.shape[1]
+            w = cols.shape[1]
+            x = np.full((n, wpad), -1, np.int8)  # pad = all-missing:
+            if base:                             # zero variance, r = 0
+                x[:, :base] = ctx
+            x[:, base : base + w] = cols
+            r2m = np.asarray(_window_r2(x, wpad))[: base + w, : base + w]
+            keep = _greedy_keep(r2m, base, self.r2)[:w]
+            kept = np.ascontiguousarray(cols[:, keep])
+            all_kept = (
+                kept if ctx is None
+                else np.concatenate([ctx, kept], axis=1)
+            )
+            ctx = np.ascontiguousarray(all_kept[:, -self.carry:])
+            ctx_contig = meta.contig
+            kp = (
+                meta.positions[keep]
+                if meta.positions is not None else None
+            )
+            yield kept, kp, meta.contig
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        """Re-chunk pruned windows into (N, <=block_variants) blocks,
+        contig-flush, pruned-stream ordinals."""
+        emitted = 0
+        for block, meta in rechunk(self._pruned_windows(), block_variants,
+                                   start_variant):
+            emitted = meta.stop
+            yield block, meta
+        if start_variant == 0:
+            self._n_variants = emitted  # completed pass counted the set

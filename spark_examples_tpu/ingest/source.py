@@ -60,6 +60,67 @@ class GenotypeSource(Protocol):
         ...
 
 
+def rechunk(items, width: int, start_variant: int = 0):
+    """Re-chunk a stream of (cols, positions | None, contig) pieces into
+    steady ``width``-wide (block, BlockMeta) outputs.
+
+    The shared machinery of every stream transform that changes the
+    variant count mid-stream (QC filtering, LD pruning, windowing):
+    buffers pieces, splits off full-width heads, flushes partials at
+    contig boundaries (the "blocks never span a contig" contract), and
+    numbers ordinals over the OUTPUT stream. ``start_variant`` skips
+    any block starting before it (ceil-align for mid-block cursors,
+    exact for self-produced stops). Positions propagate when every
+    contributing piece carries them, else None.
+    """
+    cols: list[np.ndarray] = []
+    pos: list[np.ndarray | None] = []
+    cur_contig: str | None = None
+    idx = 0
+    emitted = 0
+
+    def assemble():
+        block = cols[0] if len(cols) == 1 else np.concatenate(cols, axis=1)
+        positions = (
+            (pos[0] if len(pos) == 1 else np.concatenate(pos))
+            if all(p is not None for p in pos) else None
+        )
+        return block, positions
+
+    def emit(block, positions):
+        nonlocal idx, emitted
+        meta = BlockMeta(idx, emitted, emitted + block.shape[1],
+                         cur_contig, positions)
+        emitted += block.shape[1]
+        idx += 1
+        if meta.start >= start_variant:
+            yield np.ascontiguousarray(block), meta
+
+    for piece, p, contig in items:
+        if cols and contig != cur_contig:
+            yield from emit(*assemble())
+            cols, pos = [], []
+        cur_contig = contig
+        if piece.shape[1] == 0:
+            continue
+        cols.append(piece)
+        pos.append(np.asarray(p) if p is not None else None)
+        while sum(c.shape[1] for c in cols) >= width:
+            block, positions = assemble()
+            head, tail = block[:, :width], block[:, width:]
+            hp = tp = None
+            if positions is not None:
+                hp, tp = positions[:width], positions[width:]
+            cols = [np.ascontiguousarray(tail)] if tail.shape[1] else []
+            pos = (
+                ([tp] if positions is not None else [None])
+                if tail.shape[1] else []
+            )
+            yield from emit(head, hp)
+    if cols:
+        yield from emit(*assemble())
+
+
 def partition_ranges(
     references: Sequence[ReferenceRange], splits_per_contig: int
 ) -> list[ReferenceRange]:

@@ -78,13 +78,22 @@ def _maybe_partitioned(cls, cfg: IngestConfig):
 
 
 def build_source(cfg: IngestConfig):
-    """IngestConfig -> GenotypeSource (the reference's L2/L3 factory)."""
+    """IngestConfig -> GenotypeSource (the reference's L2/L3 factory),
+    with QC and LD-prune stream transforms layered on per config
+    (QC first — pruning monomorphic/high-missing variants is the QC
+    filter's job, and LD r^2 on them is undefined-ish anyway)."""
     src = _build_raw_source(cfg)
     if cfg.maf > 0.0 or cfg.max_missing < 1.0:
         from spark_examples_tpu.ingest.filters import FilteredSource
 
-        return FilteredSource(src, maf=cfg.maf,
-                              max_missing=cfg.max_missing)
+        src = FilteredSource(src, maf=cfg.maf,
+                             max_missing=cfg.max_missing)
+    if cfg.ld_r2 > 0.0:
+        from spark_examples_tpu.ingest.ldprune import LdPruneSource
+
+        carry = cfg.ld_carry or max(1, cfg.ld_window // 4)
+        src = LdPruneSource(src, r2=cfg.ld_r2, window=cfg.ld_window,
+                            carry=carry)
     return src
 
 
