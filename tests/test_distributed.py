@@ -90,7 +90,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_variant_gram():
+def _run_two_process(worker_src: str) -> list[dict]:
+    """Launch two coordinated jax.distributed workers on localhost and
+    return their parsed JSON outputs (shared harness for every
+    multi-process test in this file)."""
     port = _free_port()
     procs = []
     for pid in (0, 1):
@@ -105,19 +108,85 @@ def test_two_process_variant_gram():
         )
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", _WORKER], env=env, cwd=REPO,
+                [sys.executable, "-c", worker_src], env=env, cwd=REPO,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             )
         )
     outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                pytest.fail(
+                    "distributed worker timed out (coordinator stall)"
+                )
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for q in procs:  # reap siblings on any failure path
+            if q.poll() is None:
                 q.kill()
-            pytest.fail("distributed worker timed out (coordinator stall)")
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
     assert {o["process"] for o in outs} == {0, 1}
+    return outs
+
+
+def test_two_process_variant_gram():
+    outs = _run_two_process(_WORKER)
     assert all(o["max_err"] == 0.0 for o in outs), outs
+
+
+_TILE2D_WORKER = r"""
+import json, os, sys
+import numpy as np
+
+from spark_examples_tpu.core.virtual import force_virtual_cpu
+force_virtual_cpu(2)
+
+import jax
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.models.pcoa import fit_pcoa
+from spark_examples_tpu.ops import distances, gram as gram_ops
+from spark_examples_tpu.parallel import gram_sharded
+from spark_examples_tpu.parallel.pcoa_sharded import pcoa_coords_sharded
+
+meshes.maybe_init_distributed()
+assert jax.process_count() == 2, jax.process_count()
+
+N, V = 32, 96
+mesh = meshes.make_mesh()  # (2, 2) spanning both processes
+plan = gram_sharded.GramPlan(mesh, "tile2d")
+update = gram_sharded.make_update(plan, "ibs")
+acc = gram_sharded.init_sharded(plan, N, "ibs")
+
+rng = np.random.default_rng(7)
+g = rng.integers(0, 3, size=(N, V), dtype=np.int8)
+g[rng.random((N, V)) < 0.1] = -1
+
+for s in range(0, V, 32):
+    acc = update(acc, g[:, s : s + 32])
+
+# The config-4 route across PROCESSES: finalize/center/randomized eigh
+# all tile2d-sharded over the 2x2 process-spanning mesh; the collectives
+# in the sharded matmuls and mesh transposes ride the DCN analogue.
+res = pcoa_coords_sharded(plan, acc, "ibs", k=3, check_shardings=True)
+coords = np.asarray(res.coords)
+
+# Single-process oracle: dense accumulate + dense-route PCoA.
+dense = gram_ops.init(N, "ibs")
+for s in range(0, V, 32):
+    dense = gram_ops.update(dense, g[:, s : s + 32], "ibs")
+dist = distances.finalize(dense, "ibs")["distance"]
+want = fit_pcoa(np.asarray(dist), k=3, method="randomized")
+err = float(np.max(np.abs(np.abs(coords) - np.abs(np.asarray(want.coords)))))
+print(json.dumps({"process": jax.process_index(), "max_err": err}))
+"""
+
+
+def test_two_process_tile2d_sharded_solve():
+    """The 76k route's multi-host story: tile2d accumulation AND the
+    fully-sharded finalize/center/eigh running across two real
+    processes on a shared (2, 2) mesh, matching the dense route."""
+    outs = _run_two_process(_TILE2D_WORKER)
+    assert all(o["max_err"] < 1e-3 for o in outs), outs
