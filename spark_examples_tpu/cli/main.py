@@ -18,6 +18,8 @@ import argparse
 import json
 import sys
 
+import numpy as np
+
 from spark_examples_tpu.core.config import (
     ComputeConfig,
     IngestConfig,
@@ -479,6 +481,17 @@ def _print_coords(out, job: JobConfig) -> None:
         f"{len(out.sample_ids)} samples x {k} components"
         + (f" -> {job.output_path}" if job.output_path else "")
     )
+    vals = np.asarray(out.eigenvalues, float)
+    if vals.size:
+        line = "eigenvalues: " + " ".join(f"{v:.6g}" for v in vals[:10])
+        prop = getattr(out, "proportion", None)
+        if prop is not None:
+            # true scree: share of TOTAL inertia (trace-based, from the
+            # solver) — does not sum to 1 unless k captures everything
+            line += "  (explained: " + " ".join(
+                f"{p:.1%}" for p in np.asarray(prop, float)[:10]
+            ) + ")"
+        print(line)
     for sid, row in list(zip(out.sample_ids, out.coords))[:5]:
         print(sid + "\t" + "\t".join(f"{v:.4g}" for v in row[:4]))
 

@@ -32,6 +32,11 @@ class CoordsOutput:
     eigenvalues: np.ndarray
     timer: PhaseTimer
     n_variants: int = 0
+    # Fraction of TOTAL inertia per component (trace(B) = sum of ALL N
+    # eigenvalues, available without computing them) — set by the PCoA
+    # routes; None where no honest total exists (streaming subspace,
+    # projection against a persisted model).
+    proportion: np.ndarray | None = None
 
 
 def similarity_matrix_job(job: JobConfig, source=None) -> SimilarityResult:
@@ -101,7 +106,7 @@ def pcoa_job(
     if job.compute.backend == "cpu-reference":
         method = "dense"
         with timer.phase("eigh"):
-            coords, vals, _prop = oracle.pcoa(dist, k=k)
+            coords, vals, prop = oracle.pcoa(dist, k=k)
     else:
         method = _eigh_method(job.compute.eigh_mode, n)
         with timer.phase("eigh"):
@@ -109,9 +114,10 @@ def pcoa_job(
                 fit_pcoa(dist.astype(np.float32), k=k, method=method)
             )
         coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
+        prop = np.asarray(res.proportion_explained)
     _maybe_save_model(job, dist, coords, vals, sample_ids)
     return _emit_coords(job, sample_ids, coords, vals, timer, n_variants,
-                        method=method)
+                        method=method, proportion=prop)
 
 
 def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
@@ -127,7 +133,7 @@ def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
 
 def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
                  n_variants: int, method: str,
-                 eigh_iters: int = 4) -> CoordsOutput:
+                 eigh_iters: int = 4, proportion=None) -> CoordsOutput:
     """Shared output tail of every PCoA route: solver-matched FLOP
     credit, result assembly, optional TSV persistence. ``eigh_iters``
     must match the randomized solver's actual iteration count (the
@@ -137,8 +143,12 @@ def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
     timer.add("eigh_flops", eigh_flops(len(sample_ids), method=method,
                                        k=job.compute.num_pc,
                                        iters=eigh_iters))
-    out = CoordsOutput(sample_ids, np.asarray(coords), np.asarray(vals),
-                       timer, n_variants)
+    out = CoordsOutput(
+        sample_ids, np.asarray(coords), np.asarray(vals), timer,
+        n_variants,
+        proportion=(np.asarray(proportion)
+                    if proportion is not None else None),
+    )
     if job.output_path:
         pio.write_coords_tsv(job.output_path, sample_ids, out.coords)
     return out
@@ -197,7 +207,8 @@ def _pcoa_device_route(job: JobConfig, source, timer) -> CoordsOutput | None:
                           np.asarray(res.eigenvalues), grun.sample_ids)
     return _emit_coords(job, grun.sample_ids, np.asarray(res.coords),
                         np.asarray(res.eigenvalues), timer,
-                        grun.n_variants, method=method)
+                        grun.n_variants, method=method,
+                        proportion=np.asarray(res.proportion_explained))
 
 
 def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
