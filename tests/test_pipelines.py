@@ -214,3 +214,27 @@ def test_sample_stats(rng):
         assert s.het_rate == pytest.approx(
             s.n_het / s.n_called if s.n_called else 0.0
         )
+
+
+def test_pcoa_job_reports_true_inertia_proportion(rng):
+    """CoordsOutput.proportion must be the trace-based share of TOTAL
+    inertia (oracle parity), not a normalized top-k fraction that
+    always sums to 1."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+    from spark_examples_tpu.utils import oracle
+
+    g = random_genotypes(rng, 20, 400, missing_rate=0.1)
+    job = JobConfig(ingest=IngestConfig(block_variants=128),
+                    compute=ComputeConfig(metric="ibs", num_pc=3))
+    out = pcoa_job(job, source=ArraySource(g))
+    assert out.proportion is not None and out.proportion.shape == (3,)
+    from spark_examples_tpu.ops import distances, gram
+
+    acc = gram.update(gram.init(20, "ibs"), g, "ibs")
+    dist = np.asarray(distances.finalize(acc, "ibs")["distance"])
+    _, _, want = oracle.pcoa(dist, k=3)
+    np.testing.assert_allclose(out.proportion, want, atol=1e-4)
+    assert out.proportion.sum() < 0.999  # top-3 of 20 can't be all inertia
