@@ -17,6 +17,7 @@ def test_cli_pcoa_writes_coords(tmp_path, capsys):
     out = str(tmp_path / "coords.tsv")
     cap = _run(capsys, "pcoa", *BASE, "--num-pc", "3", "--output-path", out)
     assert "24 samples x 3 components" in cap.out
+    assert "eigenvalues:" in cap.out and "explained:" in cap.out
     rows = open(out).read().strip().splitlines()
     assert rows[0] == "sample\tpc1\tpc2\tpc3"
     assert len(rows) == 25
