@@ -64,6 +64,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     g.add_argument("--ld-carry", type=int, default=0,
                    help="kept variants carried across window boundaries "
                    "(0 = auto: window/4)")
+    g.add_argument("--prefetch-blocks", type=int, default=2,
+                   help="host->device pipeline depth (blocks queued "
+                   "while earlier transfers drain; minimum 1 — the "
+                   "stream cannot run unbuffered)")
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
@@ -124,6 +128,7 @@ def _job_from_args(args) -> JobConfig:
             ld_r2=args.ld_prune_r2,
             ld_window=args.ld_window,
             ld_carry=args.ld_carry,
+            prefetch_blocks=args.prefetch_blocks,
         ),
         compute=ComputeConfig(
             backend=args.backend,

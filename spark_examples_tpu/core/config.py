@@ -65,6 +65,11 @@ class IngestConfig:
     # — the emitted stream is identical to the sequential one). 1 = off.
     splits_per_contig: int = 1
     ingest_workers: int = 4
+    # Host->device pipeline depth: how many produced blocks may wait in
+    # the prefetch queue while earlier transfers/updates drain. 2 keeps
+    # the chip fed on slow links; faster ingest (NVMe/DCN) can raise it
+    # to deepen transfer/compute overlap at the cost of host RAM.
+    prefetch_blocks: int = 2
     # Variant QC thresholds, applied as a stream transform over any
     # source (ingest/filters.py): drop variants with minor-allele
     # frequency < maf or missing-call rate > max_missing. Defaults are

@@ -210,8 +210,9 @@ def pcoa_project_job(
         # Zip manually so a length mismatch is an ERROR, not a silent
         # prefix (and without consulting n_variants up front — for
         # VCF/filtered sources that property is a full extra parse).
-        it_new = iter(stream_to_device(source_new, bv))
-        it_ref = iter(stream_to_device(source_ref, bv))
+        depth = job.ingest.prefetch_blocks
+        it_new = iter(stream_to_device(source_new, bv, prefetch=depth))
+        it_ref = iter(stream_to_device(source_ref, bv, prefetch=depth))
         while True:
             nxt_new = next(it_new, None)
             nxt_ref = next(it_ref, None)

@@ -215,6 +215,7 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
         for block, meta in stream_to_device(
             source, bv, start_variant, sharding=plan.block_sharding,
             pad_multiple=n_shards, pack=packed, stats=stream_stats,
+            prefetch=job.ingest.prefetch_blocks,
         ):
             acc = update(acc, block)
             v_eff = block.shape[1] * (4 if packed else 1)
