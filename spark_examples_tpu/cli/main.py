@@ -211,6 +211,21 @@ def main(argv: list[str] | None = None) -> int:
                         "model was fitted on)")
     p_proj.add_argument("--ref-path", default=None)
 
+    p_ck = sub.add_parser(
+        "cross-kinship",
+        help="KING-robust kinship BETWEEN two cohorts (same variant "
+        "set): phi ~ 0.5 flags the same individual in both, ~0.25 "
+        "first-degree relatives — the cross-dataset dedupe/QC screen",
+    )
+    _add_common(p_ck)  # --source/--path describe the NEW cohort
+    p_ck.add_argument("--ref-source", default="plink",
+                      choices=["synthetic", "vcf", "packed", "plink"])
+    p_ck.add_argument("--ref-path", default=None)
+    p_ck.add_argument("--min-phi", type=float, default=0.177,
+                      help="console report threshold (0.177 ~ the "
+                      "KING 2nd-degree cutoff); the full matrix goes "
+                      "to --output-path")
+
     p_pack = sub.add_parser(
         "pack",
         help="ETL: stream any source into the 2-bit packed store "
@@ -389,6 +404,42 @@ def _dispatch(args, parser, job, J, build_source) -> int:
             noun="samples",
         )
         return 0
+    elif args.command == "cross-kinship":
+        import dataclasses as _dc
+
+        from spark_examples_tpu.pipelines.project import cross_kinship_job
+
+        if not args.ref_path and args.ref_source != "synthetic":
+            parser.error("cross-kinship requires --ref-path")
+        if args.maf > 0.0 or args.max_missing < 1.0 or args.ld_prune_r2 > 0:
+            parser.error(
+                "--maf/--max-missing/--ld-prune-r2 cannot apply during "
+                "cross-kinship (data-dependent masks would keep "
+                "different variant subsets per cohort); filter both "
+                "cohorts to the same sites beforehand"
+            )
+        ref_cfg = _dc.replace(job.ingest, source=args.ref_source,
+                              path=args.ref_path)
+        src_ref = build_source(ref_cfg)
+        res = cross_kinship_job(
+            job, source_new=build_source(job.ingest),
+            source_ref=src_ref,
+        )
+        phi = res.similarity
+        ref_ids = src_ref.sample_ids
+        hits = [
+            (res.sample_ids[i], ref_ids[j], float(phi[i, j]))
+            for i, j in zip(*np.nonzero(phi >= args.min_phi))
+        ]
+        print(
+            f"cross-kinship {phi.shape[0]}x{phi.shape[1]} over "
+            f"{res.n_variants} variants; {len(hits)} pairs with "
+            f"phi >= {args.min_phi}"
+            + (f" -> {job.output_path}" if job.output_path else "")
+        )
+        for a, b, p in sorted(hits, key=lambda t: -t[2])[:50]:
+            print(f"{a}\t{b}\tphi={p:.4f}")
+        timer = res.timer
     elif args.command == "project":
         import dataclasses as _dc
 

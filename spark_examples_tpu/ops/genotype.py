@@ -262,16 +262,29 @@ def _t(a):
     return a.T if hasattr(a, "T") else jnp.transpose(a)
 
 
-# Cross-cohort statistics (out-of-sample projection): operand-pair lists
-# per metric statistic. Unlike the symmetric case, the mirrored products
-# (e.g. C_new Y_ref^T vs Y_new C_ref^T) are NOT each other's transposes,
-# so each orientation is its own matmul. Each entry:
-# stat -> ((left operand of NEW cohort, right operand of REF), weight).
+# Cross-cohort statistics (out-of-sample projection, cross-kinship):
+# operand-pair lists per metric statistic. Unlike the symmetric case,
+# the mirrored products (e.g. C_new Y_ref^T vs Y_new C_ref^T) are NOT
+# each other's transposes, so each orientation is its own matmul. Each
+# entry: stat -> ((left operand of NEW cohort, right operand of REF),
+# weight). The KING pieces expand H = T1 - T2 and X0 = C - T1 into
+# indicator products exactly like the symmetric combine (ops/gram.py
+# "king"), with both orientations explicit:
+#   hh   = H_n H_r^T                 (het-het co-occurrence)
+#   opp  = X0_n T2_r^T + T2_n X0_r^T (opposite homozygotes, both ways)
+#   hcn  = H_n C_r^T                 (new-side het over complete pairs)
+#   hcr  = C_n H_r^T                 (ref-side het over complete pairs)
 CROSS_STATS: dict[str, tuple[tuple[tuple[str, str], int], ...]] = {
     "m": ((("c", "c"), 1),),
     "d1": ((("y", "c"), 1), (("c", "y"), 1),
            (("t1", "t1"), -2), (("t2", "t2"), -2)),
     "s": ((("t1", "t1"), 1),),
+    "hh": ((("t1", "t1"), 1), (("t1", "t2"), -1),
+           (("t2", "t1"), -1), (("t2", "t2"), 1)),
+    "opp": ((("c", "t2"), 1), (("t1", "t2"), -1),
+            (("t2", "c"), 1), (("t2", "t1"), -1)),
+    "hcn": ((("t1", "c"), 1), (("t2", "c"), -1)),
+    "hcr": ((("c", "t1"), 1), (("c", "t2"), -1)),
 }
 
 

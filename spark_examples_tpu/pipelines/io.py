@@ -31,23 +31,39 @@ def write_matrix(
     sample_ids: list[str],
     matrix: np.ndarray,
     kind: str | None = None,
+    col_ids: list[str] | None = None,
 ) -> None:
-    """Square matrix as TSV (header row of sample ids) or ``.npy``, plus
-    the self-description sidecar. ``kind``: similarity | distance."""
+    """Matrix as TSV (header row of column ids) or ``.npy``, plus the
+    self-description sidecar. ``kind``: similarity | distance.
+    ``col_ids``: for rectangular matrices (cross-cohort kinship) whose
+    columns index a DIFFERENT cohort than the rows; square matrices
+    leave it None (columns = rows)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    cols = col_ids if col_ids is not None else sample_ids
     if path.endswith(".npy"):
         np.save(path, matrix)
     else:
         with open(path, "w") as f:
-            f.write("sample\t" + "\t".join(sample_ids) + "\n")
+            f.write("sample\t" + "\t".join(cols) + "\n")
             for sid, row in zip(sample_ids, np.asarray(matrix)):
                 f.write(sid + "\t" + "\t".join(f"{v:.6g}" for v in row) + "\n")
+    meta = {"kind": kind, "sample_ids": list(sample_ids)}
+    if col_ids is not None:
+        meta["col_ids"] = list(col_ids)
     with open(path + ".meta.json", "w") as f:
-        json.dump({"kind": kind, "sample_ids": list(sample_ids)}, f)
+        json.dump(meta, f)
 
 
 def read_matrix(path: str) -> tuple[list[str], np.ndarray, str | None]:
-    """Inverse of write_matrix: (sample_ids, matrix, kind-or-None)."""
+    """Inverse of write_matrix for SQUARE matrices:
+    (sample_ids, matrix, kind-or-None).
+
+    Rectangular stores (cross-cohort kinship, whose sidecar carries
+    ``col_ids``) are rejected loudly — every consumer of this function
+    (the pcoa two-job handoff) assumes rows and columns index the same
+    cohort, and feeding a cross matrix through would mislabel rows with
+    the other cohort's ids before crashing on the shape.
+    """
     kind = None
     sidecar_ids = None
     meta_path = path + ".meta.json"
@@ -56,6 +72,12 @@ def read_matrix(path: str) -> tuple[list[str], np.ndarray, str | None]:
             meta = json.load(f)
         kind = meta.get("kind")
         sidecar_ids = meta.get("sample_ids")
+        if meta.get("col_ids") is not None:
+            raise ValueError(
+                f"{path}: rectangular cross-cohort matrix (rows and "
+                "columns index different cohorts) — not consumable by "
+                "the square-matrix jobs (pcoa --matrix-path)"
+            )
     if path.endswith(".npy"):
         m = np.load(path)
         ids = sidecar_ids or [f"S{i:06d}" for i in range(m.shape[0])]
@@ -63,4 +85,10 @@ def read_matrix(path: str) -> tuple[list[str], np.ndarray, str | None]:
     with open(path) as f:
         header = f.readline().rstrip("\n").split("\t")[1:]
         rows = [line.rstrip("\n").split("\t")[1:] for line in f]
-    return header, np.asarray(rows, dtype=np.float64), kind
+    m = np.asarray(rows, dtype=np.float64)
+    if m.shape[0] != m.shape[1]:
+        raise ValueError(
+            f"{path}: matrix is {m.shape[0]}x{m.shape[1]} — read_matrix "
+            "serves the square similarity/distance handoff only"
+        )
+    return header, m, kind

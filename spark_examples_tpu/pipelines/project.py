@@ -122,6 +122,106 @@ def _update_cross(acc, bn, br):
     return {k: acc[k] + upd[k] for k in acc}
 
 
+def _accumulate_cross(job, source_new, source_ref,
+                      stats: tuple[str, ...], timer):
+    """Stream BOTH cohorts in lockstep and accumulate the requested
+    cross statistics — the shared engine of projection and
+    cross-kinship. Zips manually so a length mismatch is an ERROR, not
+    a silent prefix (and without consulting n_variants up front — for
+    VCF/filtered sources that property is a full extra parse); block
+    boundaries and, when available, positions are validated per block.
+    Returns (accumulators, n_variants)."""
+    a = source_new.n_samples
+    n_ref = source_ref.n_samples
+    bv = job.ingest.block_variants
+    acc = {k: jnp.zeros((a, n_ref), jnp.int32) for k in stats}
+    n_variants = 0
+    n_matmuls = sum(len(genotype.CROSS_STATS[s]) for s in stats)
+    with timer.phase("gram"):
+        depth = job.ingest.prefetch_blocks
+        it_new = iter(stream_to_device(source_new, bv, prefetch=depth))
+        it_ref = iter(stream_to_device(source_ref, bv, prefetch=depth))
+        while True:
+            nxt_new = next(it_new, None)
+            nxt_ref = next(it_ref, None)
+            if (nxt_new is None) != (nxt_ref is None):
+                short = "new" if nxt_new is None else "reference"
+                raise ValueError(
+                    f"the {short} cohort stream ended first — both "
+                    "cohorts must carry the same variant set (a silent "
+                    "prefix-zip would compute statistics on partial "
+                    "data)"
+                )
+            if nxt_new is None:
+                break
+            (bn, mn), (br, mr) = nxt_new, nxt_ref
+            if (mn.start, mn.stop) != (mr.start, mr.stop):
+                raise ValueError(
+                    "new/reference streams diverged: new block "
+                    f"[{mn.start}, {mn.stop}) vs ref [{mr.start}, "
+                    f"{mr.stop}) — both cohorts must carry the same "
+                    "variants (same sites, same order)"
+                )
+            if (
+                mn.positions is not None
+                and mr.positions is not None
+                and not np.array_equal(mn.positions, mr.positions)
+            ):
+                raise ValueError(
+                    f"new/reference positions differ in block "
+                    f"[{mn.start}, {mn.stop}) — not the same variant set"
+                )
+            acc = _update_cross(acc, bn, br)
+            timer.add("gram_flops",
+                      2.0 * a * n_ref * bn.shape[1] * n_matmuls)
+            timer.add("ingest_bytes", bn.size + br.size)
+            n_variants = mn.stop
+        acc = hard_sync(acc)
+    return acc, n_variants
+
+
+@partial(jax.jit, static_argnames=())
+def _cross_phi(hh, opp, hcn, hcr):
+    """KING-robust kinship between cohorts (same estimator as the
+    symmetric ops/distances.py 'king' branch, both het counts over
+    pairwise-complete variants). No diagonal to pin: rows and columns
+    are different samples — a phi ~ 0.5 entry IS the finding (the same
+    individual present in both cohorts)."""
+    den = (hcn + hcr).astype(jnp.float32)
+    num = (hh - 2 * opp).astype(jnp.float32)
+    return jnp.where(den > 0, num / den, 0.0)
+
+
+def cross_kinship_job(job, source_new, source_ref):
+    """(A, N_ref) KING-robust kinship between two cohorts — the
+    cross-dataset QC screen: phi ~ 0.5 flags the same individual in
+    both cohorts, ~0.25 first-degree relatives, ~0 unrelated. Streams
+    both cohorts once; only the (A, N_ref) phi matrix comes home."""
+    from spark_examples_tpu.pipelines.runner import SimilarityResult
+
+    timer = PhaseTimer()
+    acc, n_variants = _accumulate_cross(
+        job, source_new, source_ref, ("hh", "opp", "hcn", "hcr"), timer
+    )
+    R._check_int32_budget("king", n_variants, 2)
+    with timer.phase("finalize"):
+        phi = np.asarray(hard_sync(_cross_phi(
+            acc["hh"], acc["opp"], acc["hcn"], acc["hcr"]
+        )))
+    if job.output_path:
+        pio.write_matrix(job.output_path, source_new.sample_ids, phi,
+                         kind="similarity",
+                         col_ids=source_ref.sample_ids)
+    return SimilarityResult(
+        similarity=phi,
+        distance=np.maximum(0.5 - phi, 0.0),
+        sample_ids=source_new.sample_ids,
+        metric="king",
+        timer=timer,
+        n_variants=n_variants,
+    )
+
+
 @partial(jax.jit, static_argnames=())
 def _project(m, d1, d2_colmean, d2_grand, eigvecs, eigvals):
     dist = jnp.where(m > 0, d1.astype(jnp.float32) / (2.0 * m), 0.0)
@@ -201,53 +301,9 @@ def pcoa_project_job(
 
     timer = PhaseTimer()
     stats = PROJECTABLE[(kind, metric)]
-    a = source_new.n_samples
-    bv = job.ingest.block_variants
-    acc = {k: jnp.zeros((a, n_ref), jnp.int32) for k in stats}
-    n_variants = 0
-    n_matmuls = sum(len(genotype.CROSS_STATS[s]) for s in stats)
-    with timer.phase("gram"):
-        # Zip manually so a length mismatch is an ERROR, not a silent
-        # prefix (and without consulting n_variants up front — for
-        # VCF/filtered sources that property is a full extra parse).
-        depth = job.ingest.prefetch_blocks
-        it_new = iter(stream_to_device(source_new, bv, prefetch=depth))
-        it_ref = iter(stream_to_device(source_ref, bv, prefetch=depth))
-        while True:
-            nxt_new = next(it_new, None)
-            nxt_ref = next(it_ref, None)
-            if (nxt_new is None) != (nxt_ref is None):
-                short = "new" if nxt_new is None else "reference"
-                raise ValueError(
-                    f"the {short} cohort stream ended first — both "
-                    "cohorts must carry the same variant set (a silent "
-                    "prefix-zip would compute distances on partial data)"
-                )
-            if nxt_new is None:
-                break
-            (bn, mn), (br, mr) = nxt_new, nxt_ref
-            if (mn.start, mn.stop) != (mr.start, mr.stop):
-                raise ValueError(
-                    "new/reference streams diverged: new block "
-                    f"[{mn.start}, {mn.stop}) vs ref [{mr.start}, "
-                    f"{mr.stop}) — both cohorts must carry the same "
-                    "variants (same sites, same order)"
-                )
-            if (
-                mn.positions is not None
-                and mr.positions is not None
-                and not np.array_equal(mn.positions, mr.positions)
-            ):
-                raise ValueError(
-                    f"new/reference positions differ in block "
-                    f"[{mn.start}, {mn.stop}) — not the same variant set"
-                )
-            acc = _update_cross(acc, bn, br)
-            timer.add("gram_flops",
-                      2.0 * a * n_ref * bn.shape[1] * n_matmuls)
-            timer.add("ingest_bytes", bn.size + br.size)
-            n_variants = mn.stop
-        acc = hard_sync(acc)
+    acc, n_variants = _accumulate_cross(
+        job, source_new, source_ref, stats, timer
+    )
     # Same int32-exactness guard as the symmetric path (d1's increment
     # bound is MAX_INCREMENT['ibs']); warns when counts may have wrapped.
     R._check_int32_budget(metric, n_variants, 2)

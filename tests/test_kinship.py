@@ -96,3 +96,58 @@ def test_king_pipeline_job(rng, tmp_path):
         res.similarity, oracle.naive_king(g), atol=1e-6
     )
     assert res.metric == "king"
+
+
+def test_cross_kinship_matches_symmetric_blocks(rng):
+    """The cross-cohort phi between cohorts A and B must equal the
+    off-diagonal block of the symmetric KING matrix over [A; B]."""
+    from spark_examples_tpu.core.config import IngestConfig, JobConfig
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.pipelines.project import cross_kinship_job
+
+    g = random_genotypes(rng, n=20, v=600, missing_rate=0.1)
+    a, b = g[:8], g[8:]
+    job = JobConfig(ingest=IngestConfig(block_variants=128))
+    res = cross_kinship_job(job, source_new=ArraySource(a),
+                            source_ref=ArraySource(b))
+    full = oracle.naive_king(g)
+    np.testing.assert_allclose(res.similarity, full[:8, 8:], atol=1e-6)
+
+
+def test_cross_kinship_finds_planted_duplicates_and_relatives(rng):
+    from spark_examples_tpu.core.config import IngestConfig, JobConfig
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.pipelines.project import cross_kinship_job
+
+    v = 20_000
+    p = rng.uniform(0.2, 0.8, v)
+    al = (rng.random((6, v)) < p).astype(np.int8)
+    bl = (rng.random((6, v)) < p).astype(np.int8)
+    panel = al + bl  # 6 founders
+    child = (
+        np.where(rng.random(v) < 0.5, al[0], bl[0])
+        + np.where(rng.random(v) < 0.5, al[1], bl[1])
+    ).astype(np.int8)
+    new = np.stack([panel[2].copy(), child,
+                    ((rng.random(v) < p).astype(np.int8)
+                     + (rng.random(v) < p).astype(np.int8))])
+    job = JobConfig(ingest=IngestConfig(block_variants=4096))
+    res = cross_kinship_job(job, source_new=ArraySource(new),
+                            source_ref=ArraySource(panel))
+    phi = res.similarity
+    assert abs(phi[0, 2] - 0.5) < 0.02   # duplicate of founder 2
+    assert abs(phi[1, 0] - 0.25) < 0.03  # child-parent
+    assert abs(phi[1, 1] - 0.25) < 0.03  # child-other-parent
+    assert abs(phi[2, 3]) < 0.03         # unrelated new sample
+
+
+def test_cross_matrix_rejected_by_square_reader(rng, tmp_path):
+    """A persisted cross-cohort matrix must not flow into the square
+    pcoa --matrix-path handoff (rows/columns index different cohorts)."""
+    from spark_examples_tpu.pipelines import io as pio
+
+    path = str(tmp_path / "x.tsv")
+    pio.write_matrix(path, ["a", "b"], np.zeros((2, 3)),
+                     kind="similarity", col_ids=["r0", "r1", "r2"])
+    with pytest.raises(ValueError, match="rectangular"):
+        pio.read_matrix(path)
