@@ -85,6 +85,19 @@ PIECE_PRODUCTS: dict[str, tuple[str, ...]] = {
 }
 
 
+def af_stats(block: jnp.ndarray):
+    """Missing-aware per-variant allele statistics of an int8 dosage
+    block: ``(p, cnt, y, valid)`` — alt-allele frequency over CALLED
+    genotypes, call counts, zero-masked dosages, and the valid mask.
+    The single definition of this subtle arithmetic, shared by the GRM
+    update and the cross-cohort AF-concordance check."""
+    valid = (block >= 0)
+    y = jnp.where(valid, block, 0).astype(jnp.float32)
+    cnt = valid.sum(axis=0).astype(jnp.float32)
+    p = jnp.where(cnt > 0, y.sum(axis=0) / (2.0 * cnt), 0.0)
+    return p, cnt, y, valid
+
+
 def operands(block: jnp.ndarray, dtype=jnp.int8) -> dict[str, jnp.ndarray]:
     """(N, V) int8 values -> the matmul operands.
 

@@ -144,10 +144,7 @@ def _update_grm_impl(acc: dict, block: jnp.ndarray, precise: bool = False) -> di
     continuous, unlike the exact {0,1} indicators of the counting
     metrics); f32 matmuls run at roughly half MXU rate.
     """
-    valid = (block >= 0)
-    y = jnp.where(valid, block, 0).astype(jnp.float32)
-    cnt = valid.sum(axis=0).astype(jnp.float32)  # calls per variant
-    p = jnp.where(cnt > 0, y.sum(axis=0) / (2.0 * cnt), 0.0)
+    p, cnt, y, valid = genotype.af_stats(block)
     denom = 2.0 * p * (1.0 - p)
     keep = (denom > 1e-8) & (cnt > 1)
     scale = jnp.where(keep, jax.lax.rsqrt(jnp.maximum(denom, 1e-8)), 0.0)

@@ -122,6 +122,74 @@ def _update_cross(acc, bn, br):
     return {k: acc[k] + upd[k] for k in acc}
 
 
+@jax.jit
+def _af_moments(bn, br):
+    """Per-block sufficient statistics for the cross-cohort allele-
+    frequency correlation: (count, Sx, Sy, Sxy, Sxx, Syy) over variants
+    called in BOTH cohorts. Six scalars per block — the streaming
+    Pearson-r between the cohorts' AFs, the cheap detector for swapped
+    REF/ALT coding (flips send r strongly negative). Per-block values
+    stay small (<= block width); the caller reduces across blocks in
+    float64 on the host, where f32 running sums would erode the
+    cancellation-prone variance terms at the 40M-variant scale."""
+    x, cx, _, _ = genotype.af_stats(bn)
+    y, cy, _, _ = genotype.af_stats(br)
+    both = ((cx > 0) & (cy > 0)).astype(jnp.float32)
+    x = x * both
+    y = y * both
+    return jnp.stack([
+        both.sum(), x.sum(), y.sum(), (x * y).sum(),
+        (x * x).sum(), (y * y).sum(),
+    ])
+
+
+def _check_af_concordance(moments: np.ndarray, a: int, n_ref: int) -> None:
+    """Warn when the cohorts' allele frequencies disagree — the classic
+    silent killer of cross-dataset analyses is REF/ALT coding swapped
+    in one cohort (dosage g becomes 2-g), which degrades projection and
+    kinship with no error anywhere.
+
+    Two regimes, because AF estimates from a SMALL cohort are noisy
+    (per-variant sampling variance ~ E[p(1-p)]/2A attenuates the
+    correlation toward 0 even for perfectly concordant coding — a
+    single projected sample tops out around r ~ 0.3-0.5):
+
+    - r strongly NEGATIVE: sampling noise only attenuates toward zero,
+      never below it, so this always indicates allele flips — warn at
+      any cohort size.
+    - r merely LOW: only meaningful when both cohorts are large enough
+      (>= 20 samples each) that attenuation is a few percent; then a
+      sub-0.5 correlation indicates a variant-order or coding mismatch.
+    """
+    n, sx, sy, sxy, sxx, syy = (float(v) for v in moments)
+    if n < 20:
+        return  # too few shared variants to judge
+    vx = sxx - sx * sx / n
+    vy = syy - sy * sy / n
+    if vx <= 0 or vy <= 0:
+        return  # a cohort with constant AF carries no signal
+    r = (sxy - sx * sy / n) / np.sqrt(vx * vy)
+    flip = r < -0.2
+    low = r < 0.5 and min(a, n_ref) >= 20
+    if flip or low:
+        import warnings
+
+        warnings.warn(
+            f"cross-cohort allele-frequency correlation is {r:.3f} "
+            "(expected ~1 for the same variant set with the same "
+            "REF/ALT coding)"
+            + (
+                " — negative correlation means one cohort's alleles "
+                "are swapped (dosage 2-g)"
+                if flip
+                else " — likely a variant-order or coding mismatch"
+            )
+            + "; results will be wrong until the cohorts are harmonized",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
 def _accumulate_cross(job, source_new, source_ref,
                       stats: tuple[str, ...], timer):
     """Stream BOTH cohorts in lockstep and accumulate the requested
@@ -135,6 +203,7 @@ def _accumulate_cross(job, source_new, source_ref,
     n_ref = source_ref.n_samples
     bv = job.ingest.block_variants
     acc = {k: jnp.zeros((a, n_ref), jnp.int32) for k in stats}
+    moment_blocks = []  # tiny per-block device vectors, reduced in f64
     n_variants = 0
     n_matmuls = sum(len(genotype.CROSS_STATS[s]) for s in stats)
     with timer.phase("gram"):
@@ -172,11 +241,18 @@ def _accumulate_cross(job, source_new, source_ref,
                     f"[{mn.start}, {mn.stop}) — not the same variant set"
                 )
             acc = _update_cross(acc, bn, br)
+            moment_blocks.append(_af_moments(bn, br))
             timer.add("gram_flops",
                       2.0 * a * n_ref * bn.shape[1] * n_matmuls)
             timer.add("ingest_bytes", bn.size + br.size)
             n_variants = mn.stop
         acc = hard_sync(acc)
+    if moment_blocks:
+        # One stacked fetch, then a float64 host reduction — per-block
+        # f32 values are small and exact-ish; the cross-block sums (and
+        # the cancellation-prone variance terms downstream) are not.
+        stacked = np.asarray(jnp.stack(moment_blocks), np.float64)
+        _check_af_concordance(stacked.sum(axis=0), a, n_ref)
     return acc, n_variants
 
 

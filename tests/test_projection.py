@@ -222,3 +222,64 @@ def test_project_cli_flow(rng, tmp_path, capsys):
     got = np.loadtxt(coords, skiprows=1, usecols=(1, 2, 3))
     assert got.shape == (10, 3)
     capsys.readouterr()
+
+
+def test_allele_flip_detected(rng, tmp_path):
+    """Swapped REF/ALT coding in one cohort (dosage g -> 2-g) must warn
+    loudly — it silently corrupts projection/kinship otherwise."""
+    import warnings
+
+    g = random_genotypes(rng, n=16, v=600, missing_rate=0.05)
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=128),
+        compute=ComputeConfig(metric="ibs", num_pc=3),
+        model_path=model,
+    )
+    pcoa_job(job, source=ArraySource(g))
+    flipped = np.where(g >= 0, 2 - g, -1).astype(np.int8)
+    with pytest.warns(RuntimeWarning, match="allele-frequency"):
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(flipped), source_ref=ArraySource(g),
+        )
+    # concordant cohorts stay silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(g), source_ref=ArraySource(g),
+        )
+    assert not [x for x in w if "allele-frequency" in str(x.message)]
+
+
+def test_single_sample_projection_does_not_warn(rng, tmp_path):
+    """A one-sample new cohort has very noisy per-variant AFs (r tops
+    out ~0.3-0.5 vs the panel even with identical coding); the
+    concordance check must not cry wolf on this flagship use case —
+    only a NEGATIVE correlation (true flips) warns at small sizes."""
+    import warnings
+
+    g, _ = _cohort(rng, n=40, v=3000)
+    ref, one = g[:39], g[39:]
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=512),
+        compute=ComputeConfig(metric="ibs", num_pc=3),
+        model_path=model,
+    )
+    pcoa_job(job, source=ArraySource(ref))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(one), source_ref=ArraySource(ref),
+        )
+    assert not [x for x in w if "allele-frequency" in str(x.message)]
+    # but a FLIPPED single sample still warns (negative correlation)
+    flipped = np.where(one >= 0, 2 - one, -1).astype(np.int8)
+    with pytest.warns(RuntimeWarning, match="swapped"):
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(flipped), source_ref=ArraySource(ref),
+        )
