@@ -152,8 +152,17 @@ def test_gram_throughput_floor_on_tpu():
     (measured 150-280 TFLOP/s across sessions; the floor leaves room
     for barrier-RTT variance on slow dev tunnels, but catches
     order-of-magnitude lowering regressions — e.g. the MXU path
-    silently degrading to VPU or f32)."""
-    out = _run_on_hw(_PERF_SCRIPT, strict=True)
+    silently degrading to VPU or f32). One retry absorbs transient
+    tunnel blips mid-benchmark (observed ~1-in-10 during suite soaks);
+    a persistent crash still fails — the crash IS the regression."""
+    retryable = (Exception, pytest.fail.Exception, pytest.skip.Exception)
+    for attempt in (1, 2):
+        try:
+            out = _run_on_hw(_PERF_SCRIPT, strict=True)
+            break
+        except retryable:
+            if attempt == 2:
+                raise
     if "skip" in out:
         pytest.skip(out["skip"])
     assert out["tflops"] > 30.0, out
