@@ -154,6 +154,10 @@ def main(argv: list[str] | None = None) -> int:
         description="TPU-native population-genomics pipelines "
         "(similarity / PCoA / PCA / search)",
     )
+    from spark_examples_tpu.version import __version__
+
+    parser.add_argument("--version", action="version",
+                        version=f"%(prog)s {__version__}")
     sub = parser.add_subparsers(dest="command", required=True)
 
     p_sim = sub.add_parser("similarity", help="pairwise similarity matrix")

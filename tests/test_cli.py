@@ -1,4 +1,5 @@
 import numpy as np
+import pytest
 
 from spark_examples_tpu.cli.main import main
 from spark_examples_tpu.pipelines.io import read_matrix, write_matrix
@@ -135,3 +136,33 @@ def test_cli_sample_stats(tmp_path, capsys):
     assert len(rows) == 25  # header + 24 samples
     cols = rows[1].split("\t")
     assert len(cols) == 6 and 0.0 <= float(cols[2]) <= 1.0
+
+
+def test_cli_version(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--version"])
+    assert e.value.code == 0
+    from spark_examples_tpu.version import __version__
+
+    assert __version__ in capsys.readouterr().out
+
+
+def test_cli_pack_with_ld_prune(tmp_path, capsys):
+    """pack composes with the QC/LD transforms (two passes: the count
+    for preallocation, then the stream) and the store holds the pruned
+    set."""
+    rng = np.random.default_rng(6)
+    base = rng.integers(0, 3, (120, 30), dtype=np.int8)
+    # interleave each variant with its duplicate (adjacent, well inside
+    # the pruning window — pairs farther apart than window+carry are
+    # out of reach by design)
+    g = np.repeat(base, 2, axis=1)
+    from spark_examples_tpu.ingest.vcf import write_vcf
+
+    vcf = str(tmp_path / "c.vcf")
+    write_vcf(vcf, g)
+    store = str(tmp_path / "store")
+    cap = _run(capsys, "pack", "--source", "vcf", "--path", vcf,
+               "--ld-prune-r2", "0.3", "--ld-window", "20",
+               "--block-variants", "16", "--output-path", store)
+    assert "x 30 variants" in cap.out  # every duplicate pruned
