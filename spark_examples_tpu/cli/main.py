@@ -20,6 +20,7 @@ import sys
 
 import numpy as np
 
+from spark_examples_tpu.version import __version__  # noqa: F401 - CLI flag
 from spark_examples_tpu.core.config import (
     ComputeConfig,
     IngestConfig,
@@ -154,8 +155,6 @@ def main(argv: list[str] | None = None) -> int:
         description="TPU-native population-genomics pipelines "
         "(similarity / PCoA / PCA / search)",
     )
-    from spark_examples_tpu.version import __version__
-
     parser.add_argument("--version", action="version",
                         version=f"%(prog)s {__version__}")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -293,7 +292,15 @@ def main(argv: list[str] | None = None) -> int:
     # command path below stops the trace on its way out.
     with contextlib.ExitStack() as stack:
         stack.enter_context(profiling.trace(getattr(args, "trace_dir", None)))
-        return _dispatch(args, parser, job, J, build_source)
+        try:
+            return _dispatch(args, parser, job, J, build_source)
+        except BrokenPipeError:
+            # Downstream closed early (`... | head`): normal for a CLI.
+            # Point stdout at devnull so the interpreter's shutdown
+            # flush doesn't raise a second time, and exit cleanly.
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, sys.stdout.fileno())
+            return 0
 
 
 _PREVIEW_ROWS = 50
