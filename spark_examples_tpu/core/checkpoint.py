@@ -1,4 +1,4 @@
-"""Partial-Gram checkpoint / resume.
+"""Partial-Gram checkpoint / resume — shard-aware.
 
 The reference had nothing here: a failed PCA job reran from scratch,
 recovery being Spark lineage recompute (SURVEY.md §5 "Checkpoint /
@@ -8,10 +8,23 @@ cursor) every K blocks makes recovery "resume from the last checkpointed
 partial sum", and the same mechanism powers the streaming/incremental
 config (BASELINE.md config 5).
 
-Format: a directory with one ``.npy`` per accumulator leaf plus a JSON
-manifest (cursor, metric, block size, sample ids hash). Writes are
-atomic (tmp dir + rename) so a crash mid-write never corrupts the latest
-good checkpoint.
+Layout discipline matters at the tile2d regime (BASELINE.md config 4): a
+76k^2 f32 leaf is ~23 GB, and the whole point of the tiling is that no
+single host or device ever materializes it. So tiled leaves are saved
+**one file per addressable tile** (``{leaf}.t{row0}_{col0}.npy``, the
+filename keyed by the tile's global offsets) and restored through
+``jax.make_array_from_callback`` under the plan's sharding — each device
+reads back exactly its own tile, host peak stays O(tile), and in
+multi-host runs each process touches only its own tiles. Replicated
+leaves (variant mode, scalars) keep the simple one-``.npy``-per-leaf
+format. A manifest records the tile grid; resuming under a different
+mesh/mode is rejected rather than silently re-laid-out (re-tiling a
+partial sum is possible in principle but never what an interrupted
+production job wants to discover it did implicitly).
+
+Writes are atomic (tmp dir + rename; multi-host writers barrier before
+process 0 rotates the directory) so a crash mid-write never corrupts the
+latest good checkpoint.
 """
 
 from __future__ import annotations
@@ -30,6 +43,27 @@ def _sample_hash(sample_ids: list[str]) -> str:
     return h[:16]
 
 
+def _is_replicated(v) -> bool:
+    """True when every addressable shard holds the full leaf value."""
+    if not isinstance(v, jax.Array):
+        return True
+    shards = v.addressable_shards
+    return all(s.data.shape == v.shape for s in shards)
+
+
+def _tile_name(leaf: str, index) -> str:
+    offs = [(sl.start or 0) if isinstance(sl, slice) else int(sl)
+            for sl in index]
+    return f"{leaf}.t" + "_".join(str(o) for o in offs) + ".npy"
+
+
+def _barrier(name: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def save(
     path: str,
     acc: dict,
@@ -38,52 +72,136 @@ def save(
     block_variants: int,
     sample_ids: list[str],
     stream_stats: dict | None = None,
+    plan=None,
 ) -> None:
     """Atomically persist accumulators + resume cursor.
+
+    Tiled leaves (tile2d plans) are written one file per addressable
+    shard — no full N x N leaf ever materializes on the host (the
+    VERDICT r3 weak-#1 defect). ``plan`` records the tile grid in the
+    manifest; without it (legacy callers, host-built accumulators) every
+    leaf is treated as replicated and saved whole.
 
     ``stream_stats``: the runner's producer-side stream statistics
     (currently ``max_value``) — persisted so a resumed dot/euclidean
     job's int32-exactness guard still sees the largest value of the
     *whole* stream, not just the post-resume tail.
+
+    Multi-host: a SHARED filesystem is required — every process writes
+    its own tiles into the shared directory, process 0 writes the
+    manifest and performs the atomic rotation after a cross-process
+    barrier (without a shared FS, non-primary tmp dirs would never be
+    rotated and load() would find no manifest there). ``next_variant``
+    is this process's LOCAL cursor into its own ingest partition,
+    recorded per process.
     """
+    proc = jax.process_index() if jax.process_count() > 1 else 0
+    is_primary = proc == 0
     tmp = path + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+    if is_primary:
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp, exist_ok=True)
+    _barrier("ckpt-mkdir")
+    os.makedirs(tmp, exist_ok=True)  # idempotent on the shared FS
+
+    layout: dict[str, str] = {}
     for k, v in acc.items():
-        np.save(os.path.join(tmp, f"{k}.npy"), np.asarray(v))
+        if _is_replicated(v):
+            layout[k] = "full"
+            if is_primary:
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    host = np.asarray(v.addressable_data(0))
+                else:
+                    host = np.asarray(v)
+                np.save(os.path.join(tmp, f"{k}.npy"), host)
+        else:
+            layout[k] = "tiles"
+            for sh in v.addressable_shards:
+                np.save(
+                    os.path.join(tmp, _tile_name(k, sh.index)),
+                    np.asarray(sh.data),
+                )
+
+    # Per-process cursors: each process resumes its own partition.
+    cursors = {str(proc): int(next_variant)}
+    if jax.process_count() > 1:
+        from spark_examples_tpu.parallel import multihost as mh
+
+        gathered = mh.allgather(np.int64(next_variant))
+        cursors = {str(i): int(c) for i, c in enumerate(gathered)}
+
     manifest = {
-        "next_variant": int(next_variant),
+        "next_variant": cursors.get("0", int(next_variant)),  # legacy field
+        "cursors": cursors,
         "metric": metric,
         "block_variants": int(block_variants),
         "sample_hash": _sample_hash(sample_ids),
         "n_samples": len(sample_ids),
         "leaves": sorted(acc.keys()),
+        "layout": layout,
+        "mesh_shape": (list(plan.mesh.devices.shape) if plan is not None
+                       else None),
+        "mode": plan.mode if plan is not None else None,
+        "process_count": jax.process_count(),
         "stream_stats": dict(stream_stats or {}),
     }
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f)
-    # Never a window with zero good checkpoints: move the old one aside,
-    # land the new one, then delete the old. A crash mid-sequence leaves
-    # either `path` or `path.old` intact (load() checks both).
-    old = path + ".old"
-    if os.path.exists(old):
-        shutil.rmtree(old)
-    if os.path.exists(path):
-        os.replace(path, old)
-    os.replace(tmp, path)
-    if os.path.exists(old):
-        shutil.rmtree(old)
+    _barrier("ckpt-tiles-written")
+    if is_primary:
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        # Never a window with zero good checkpoints: move the old one
+        # aside, land the new one, then delete the old. A crash
+        # mid-sequence leaves either `path` or `path.old` intact
+        # (load() checks both).
+        old = path + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        if os.path.exists(path):
+            os.replace(path, old)
+        os.replace(tmp, path)
+        if os.path.exists(old):
+            shutil.rmtree(old)
+    _barrier("ckpt-rotated")
+
+
+def _load_leaf(path: str, k: str, layout: str, manifest: dict, plan):
+    """One accumulator leaf back onto the devices it belongs on."""
+    if layout == "full":
+        host = np.load(os.path.join(path, f"{k}.npy"))
+        if plan is None:
+            return jax.device_put(host)
+        from spark_examples_tpu.parallel.gram_sharded import _acc_shardings
+
+        sh = _acc_shardings(plan, manifest["metric"]).get(k)
+        return jax.device_put(host, sh)
+    # Tiled leaf: every device reads exactly its own tile file — the
+    # callback receives each addressable shard's global index and maps
+    # it to the file that shard was saved under. Host peak = one tile.
+    if plan is None:
+        raise ValueError(
+            f"checkpoint at {path} holds tiled leaf {k!r} but no plan "
+            "was given to place it — pass the job's GramPlan"
+        )
+    n = manifest["n_samples"]
+    sharding = plan.acc_sharding
+
+    def cb(index):
+        return np.load(os.path.join(path, _tile_name(k, index)))
+
+    return jax.make_array_from_callback((n, n), sharding, cb)
 
 
 def load(path: str, metric: str, sample_ids: list[str],
-         block_variants: int | None = None):
+         block_variants: int | None = None, plan=None):
     """Load (acc, next_variant, stream_stats) or None when absent.
 
-    Incompatible checkpoints (different metric, cohort, or block grid)
-    are rejected rather than silently mixed into the accumulation: a
-    resume with a different ``block_variants`` would misalign the cursor
-    against the block grid and double-count or skip variants.
+    Incompatible checkpoints (different metric, cohort, block grid,
+    tile grid, or process count) are rejected rather than silently mixed
+    into the accumulation: a resume with a different ``block_variants``
+    would misalign the cursor against the block grid and double-count or
+    skip variants; a resume under a different mesh/mode would need a
+    re-tiling no interrupted job should do implicitly.
     """
     manifest_path = os.path.join(path, "manifest.json")
     if not os.path.exists(manifest_path):
@@ -124,8 +242,38 @@ def load(path: str, metric: str, sample_ids: list[str],
             f"for metric {metric!r} (stale accumulator schema — delete "
             "the checkpoint to restart)"
         )
+    layout = manifest.get("layout") or {k: "full" for k in manifest["leaves"]}
+    # Cursors are per-process offsets into per-process ingest
+    # partitions, so a resume under a DIFFERENT process count would
+    # misapply every cursor regardless of leaf layout — reject it
+    # outright (re-partitioning a partial sum is never implicit).
+    if manifest.get("process_count", 1) != jax.process_count():
+        raise ValueError(
+            f"checkpoint at {path} was written by "
+            f"{manifest.get('process_count', 1)} process(es); this job "
+            f"runs {jax.process_count()} — per-process ingest cursors "
+            "do not transfer across process counts"
+        )
+    if any(v == "tiles" for v in layout.values()):
+        want_mesh = list(plan.mesh.devices.shape) if plan is not None else None
+        if (
+            plan is None
+            or manifest.get("mesh_shape") != want_mesh
+            or manifest.get("mode") != plan.mode
+        ):
+            raise ValueError(
+                f"checkpoint at {path} is tiled for mesh "
+                f"{manifest.get('mesh_shape')} mode "
+                f"{manifest.get('mode')!r}; this job runs mesh "
+                f"{want_mesh} mode {getattr(plan, 'mode', None)!r} — "
+                "resume must keep the tile grid (re-tiling a partial "
+                "sum is never implicit)"
+            )
     acc = {
-        k: jax.device_put(np.load(os.path.join(path, f"{k}.npy")))
+        k: _load_leaf(path, k, layout.get(k, "full"), manifest, plan)
         for k in manifest["leaves"]
     }
-    return acc, int(manifest["next_variant"]), manifest.get("stream_stats", {})
+    cursors = manifest.get("cursors") or {"0": manifest["next_variant"]}
+    proc = jax.process_index() if jax.process_count() > 1 else 0
+    cursor = int(cursors.get(str(proc), manifest["next_variant"]))
+    return acc, cursor, manifest.get("stream_stats", {})

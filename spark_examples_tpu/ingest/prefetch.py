@@ -26,8 +26,9 @@ from spark_examples_tpu.ingest.source import BlockMeta, GenotypeSource
 
 _END = object()
 
-# A byte of four missing codes (0b11_11_11_11) — the packed twin of MISSING.
-_PACKED_MISSING = 0xFF
+# A byte of four missing codes (0b11_11_11_11) — the packed twin of
+# MISSING, shared with the multi-host feeder's padding slabs.
+PACKED_MISSING = 0xFF
 
 
 def pad_block(block: np.ndarray, block_variants: int) -> np.ndarray:
@@ -45,9 +46,42 @@ def pad_packed(packed: np.ndarray, width_bytes: int) -> np.ndarray:
     n, w = packed.shape
     if w == width_bytes:
         return packed
-    out = np.full((n, width_bytes), _PACKED_MISSING, dtype=np.uint8)
+    out = np.full((n, width_bytes), PACKED_MISSING, dtype=np.uint8)
     out[:, :w] = packed
     return out
+
+
+def padded_width(block_variants: int, pad_multiple: int = 1,
+                 pack: bool = False) -> int:
+    """The (host-side) column width every streamed block is padded to —
+    bytes when ``pack``, variants otherwise. Exposed so the multi-host
+    feeder (parallel/multihost.py) can agree on a global block shape
+    across processes without consulting any data."""
+    grid = pad_multiple * (bitpack.VARIANTS_PER_BYTE if pack else 1)
+    width = -(-block_variants // grid) * grid
+    return width // bitpack.VARIANTS_PER_BYTE if pack else width
+
+
+def stream_host_blocks(
+    source: GenotypeSource,
+    block_variants: int,
+    start_variant: int = 0,
+    prefetch: int = 2,
+    pad_multiple: int = 1,
+    pack: bool = False,
+    stats: dict | None = None,
+) -> Iterator[tuple[np.ndarray, BlockMeta]]:
+    """Yield shape-stable padded HOST blocks from a producer thread.
+
+    The host half of :func:`stream_to_device` — same producer thread,
+    bounded queue, padding, packing, and stats contract, but the blocks
+    stay host-resident. The multi-host feeder consumes this directly
+    (each process assembles its slab into a global array itself).
+    """
+    yield from _produce_host_blocks(
+        source, block_variants, start_variant, prefetch, pad_multiple,
+        pack, stats,
+    )
 
 
 def stream_to_device(
@@ -87,6 +121,23 @@ def stream_to_device(
     the runner's int32-accumulator exactness guard for arbitrary int8
     tables; computed off the critical path.
     """
+    for host_block, meta in _produce_host_blocks(
+        source, block_variants, start_variant, prefetch, pad_multiple,
+        pack, stats,
+    ):
+        if sharding is not None:
+            dev_block = jax.device_put(host_block, sharding)
+        elif device is not None:
+            dev_block = jax.device_put(host_block, device)
+        else:
+            dev_block = jax.device_put(host_block)
+        yield dev_block, meta
+
+
+def _produce_host_blocks(
+    source, block_variants, start_variant, prefetch, pad_multiple, pack,
+    stats,
+):
     q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
     stop = threading.Event()
     grid = pad_multiple * (bitpack.VARIANTS_PER_BYTE if pack else 1)
@@ -140,13 +191,6 @@ def stream_to_device(
                 return
             if isinstance(item, BaseException):
                 raise item
-            host_block, meta = item
-            if sharding is not None:
-                dev_block = jax.device_put(host_block, sharding)
-            elif device is not None:
-                dev_block = jax.device_put(host_block, device)
-            else:
-                dev_block = jax.device_put(host_block)
-            yield dev_block, meta
+            yield item
     finally:
         stop.set()

@@ -187,6 +187,160 @@ class ArraySource:
             yield block, BlockMeta(idx, lo, hi, self.contig, pos)
 
 
+@dataclass
+class WindowSource:
+    """Restrict a source to a contiguous variant window ``[start, stop)``.
+
+    The per-process ingest partition of the multi-host job surface
+    (parallel/multihost.py): every process wraps the same underlying
+    source in its own window and *reads only that window* — the
+    TPU-native successor of the reference's one-RDD-partition-per-
+    executor split (SURVEY.md §2.1 "Genomic-range partitioners") for
+    sources with cheap random access (synthetic generation, memmapped
+    packed/array stores). ``start`` must be aligned to the block grid
+    the stream will use; ``stop`` is either block-aligned or the end of
+    the underlying source. Cursors (resume) and block ordinals are local
+    to the window.
+    """
+
+    inner: GenotypeSource
+    start: int
+    stop: int
+
+    def __post_init__(self):
+        if not 0 <= self.start <= self.stop <= self.inner.n_variants:
+            raise ValueError(
+                f"window [{self.start}, {self.stop}) out of range for a "
+                f"{self.inner.n_variants}-variant source"
+            )
+        # Only advertise packed transport when the inner source has it
+        # (prefetch dispatches on hasattr).
+        if hasattr(self.inner, "packed_blocks"):
+            self.packed_blocks = self._packed_blocks
+
+    @property
+    def n_samples(self) -> int:
+        return self.inner.n_samples
+
+    @property
+    def n_variants(self) -> int:
+        return self.stop - self.start
+
+    @property
+    def sample_ids(self) -> list[str]:
+        return self.inner.sample_ids
+
+    def _relocalize(self, it):
+        idx = 0
+        for block, meta in it:
+            if meta.start >= self.stop:
+                break
+            take = min(meta.stop, self.stop) - meta.start
+            if take < block.shape[1]:
+                block = np.ascontiguousarray(block[:, :take])
+            pos = meta.positions
+            if pos is not None and take < len(pos):
+                pos = pos[:take]
+            yield block, dataclasses.replace(
+                meta,
+                index=idx,
+                start=meta.start - self.start,
+                stop=meta.start - self.start + take,
+                positions=pos,
+            )
+            idx += 1
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        if self.start % block_variants:
+            raise ValueError(
+                f"window start {self.start} not aligned to block grid "
+                f"{block_variants} — inner cursors would ceil-align past "
+                "the window's own variants"
+            )
+        yield from self._relocalize(
+            self.inner.blocks(block_variants, self.start + start_variant)
+        )
+
+    def _packed_blocks(self, block_variants: int, start_variant: int = 0):
+        if self.start % block_variants:
+            raise ValueError(
+                f"window start {self.start} not aligned to block grid "
+                f"{block_variants}"
+            )
+        it = self.inner.packed_blocks(
+            block_variants, self.start + start_variant
+        )
+        # Packed blocks are (N, width/4) bytes; _relocalize's column
+        # truncation must therefore work in bytes.
+        idx = 0
+        for pblock, meta in it:
+            if meta.start >= self.stop:
+                break
+            from spark_examples_tpu.ingest import bitpack
+
+            take = min(meta.stop, self.stop) - meta.start
+            take_bytes = bitpack.packed_width(take)
+            if take_bytes < pblock.shape[1]:
+                pblock = np.ascontiguousarray(pblock[:, :take_bytes])
+            yield pblock, dataclasses.replace(
+                meta,
+                index=idx,
+                start=meta.start - self.start,
+                stop=meta.start - self.start + take,
+                positions=None,
+            )
+            idx += 1
+
+
+@dataclass
+class EmptyShare:
+    """A zero-variant partition that still answers cohort metadata.
+
+    Multi-host range partitioning can leave a process with no ranges at
+    all (more processes than sub-ranges of a small contig). Building the
+    underlying source with ``references=[]`` would mean "no filter" and
+    silently re-read the WHOLE file into the global accumulation — so an
+    empty share gets this instead: sample metadata from the inner source
+    (consistency checks still hold), an empty stream, and the consensus
+    feeder pads its steps with missing slabs.
+    """
+
+    inner: GenotypeSource
+
+    @property
+    def n_samples(self) -> int:
+        return self.inner.n_samples
+
+    @property
+    def n_variants(self) -> int:
+        return 0
+
+    @property
+    def sample_ids(self) -> list[str]:
+        return self.inner.sample_ids
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        return iter(())
+
+
+def window_for_process(
+    n_variants: int, block_variants: int, process_index: int,
+    process_count: int,
+) -> tuple[int, int]:
+    """Block-aligned contiguous [start, stop) window for one process.
+
+    Splits ceil(V / bv) blocks into ``process_count`` contiguous runs of
+    at most ceil(n_blocks / P) blocks each; trailing processes may get an
+    empty window when blocks run out (their stream is empty and the
+    multi-host feeder pads them with missing slabs).
+    """
+    n_blocks = -(-n_variants // block_variants)
+    per = -(-n_blocks // max(1, process_count))
+    start = min(process_index * per * block_variants, n_variants)
+    stop = min((process_index + 1) * per * block_variants, n_variants)
+    return start, stop
+
+
 def concat_sources(sources: Sequence[GenotypeSource]) -> "ChainSource":
     return ChainSource(list(sources))
 

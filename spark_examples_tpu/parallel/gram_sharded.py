@@ -14,9 +14,14 @@ is sharding annotations on the *same* jitted computation
   N x N Gram on-device" the north star prescribes (BASELINE.json:5).
 - **tile2d mode** (the 76k-exome regime, BASELINE.md config 4): the
   accumulator is tiled (rows over mesh axis i, cols over j) so each chip
-  holds an (N/p_i, N/p_j) tile; blocks are replicated and each chip
-  contracts only its row-slice against its col-slice — no collectives in
-  the hot loop at all, communication moves to ingest broadcast.
+  holds an (N/p_i, N/p_j) tile; blocks arrive variant-sharded (each chip
+  is fed 1/n_dev of the block over the host link) and XLA all-gathers
+  the block over ICI before each chip contracts its row-slice against
+  its col-slice — host→device traffic per chip drops by n_dev, and the
+  gather rides ICI, which is orders of magnitude faster than the host
+  link. This is also exactly the transport the multi-host path needs:
+  each process feeds only its own variant slice
+  (parallel/multihost.py).
 - **replicated mode**: single-chip degenerate case (mesh (1,1)).
 
 Mode choice is automatic from accumulator-memory footprint unless forced.
@@ -55,9 +60,19 @@ class GramPlan:
 
     @property
     def block_sharding(self) -> NamedSharding:
-        if self.mode == "variant":
+        # Both multi-device modes transport blocks variant-sharded: in
+        # variant mode that IS the compute layout (local dot + psum); in
+        # tile2d mode XLA all-gathers the shards over ICI inside the
+        # update — either way each chip's host link carries 1/n_dev of
+        # every block, and each *process* can feed only its own slice.
+        if self.mode in ("variant", "tile2d"):
             return meshes.variants_flat(self.mesh)
         return meshes.replicated(self.mesh)
+
+    @property
+    def block_shards(self) -> int:
+        """How many ways the variant axis of a block is split."""
+        return self.mesh.devices.size if self.mode != "replicated" else 1
 
 
 def plan_for(
@@ -125,7 +140,7 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False,
     only its own quarter-width slice.
     """
     jitted = _jitted_update(plan, metric, packed, grm_precise)
-    n_shards = plan.mesh.devices.size if plan.mode == "variant" else 1
+    n_shards = plan.block_shards
 
     def update(acc, block):
         if not (isinstance(block, jax.Array) and block.sharding == plan.block_sharding):

@@ -1,0 +1,137 @@
+"""Multi-host job surface: per-process ingest feeding a process-spanning mesh.
+
+The reference's executors each read *their own* RDD partition's genomic
+range and merged pair counts through the shuffle (SURVEY.md §2.2, §3.5).
+The TPU-native successor, completing the DCN story SURVEY §5 names
+("`jax.distributed` init plus host-side ingest feeding"):
+
+- **partition the reading** — every process builds a source over only its
+  share of the input: genomic-range partitions (``partition_ranges``) for
+  file sources driven by ``--references``, block-aligned variant windows
+  (:class:`~spark_examples_tpu.ingest.source.WindowSource`) for sources
+  with cheap random access (synthetic, memmapped packed/array stores);
+- **assemble blocks without replication** — each process feeds its local
+  slab into :func:`jax.make_array_from_process_local_data` under the
+  plan's variant-sharded block transport, so no process ever
+  materializes another process's variants (the global block exists only
+  as its per-device shards);
+- **agree on the step count** — the gram update is one SPMD program per
+  block; every process must execute it the same number of times. Range
+  partitions are only approximately equal, so each step runs a tiny
+  allgathered "anyone still has data?" consensus, and exhausted
+  processes feed all-MISSING slabs (semantically zero for every gram
+  piece) until the last straggler drains.
+
+The accumulation itself is unchanged — the same jitted update with the
+same shardings (parallel/gram_sharded.py); XLA's collectives simply span
+processes once the mesh does.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.experimental import multihost_utils
+
+from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE, MISSING
+from spark_examples_tpu.ingest.prefetch import (
+    PACKED_MISSING,
+    padded_width,
+    stream_host_blocks,
+)
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def allgather(value) -> np.ndarray:
+    """Gather one small host value from every process -> (P, ...) array.
+
+    Thin wrapper over ``multihost_utils.process_allgather`` so call sites
+    stay grep-able; used for step-count consensus, global variant totals,
+    and stream-stat merges — control-plane traffic, never genotype data.
+    """
+    return np.asarray(multihost_utils.process_allgather(np.asarray(value)))
+
+
+def fetch_replicated(x):
+    """``np.asarray`` that tolerates process-spanning arrays.
+
+    A replicated global array is not "fully addressable" from any one
+    process, so ``np.asarray`` on it raises — but every addressable
+    shard holds the complete value. Tile-sharded matrices must go
+    through the sharded solve instead of ever being fetched whole, and
+    feeding one here raises rather than silently returning a single
+    tile as if it were the full matrix.
+    """
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        local = x.addressable_data(0)
+        if local.shape != x.shape:
+            raise ValueError(
+                f"fetch_replicated got a {x.shape} array whose local "
+                f"shard is only {local.shape} — a sharded (not "
+                "replicated) layout; route it through the sharded "
+                "solve / per-shard IO instead of fetching it whole"
+            )
+        return np.asarray(local)
+    return np.asarray(x)
+
+
+def stream_global_blocks(
+    source,
+    block_variants: int,
+    start_variant: int,
+    plan,
+    pack: bool,
+    stats: dict | None = None,
+    prefetch: int = 2,
+):
+    """Yield ``(global_block, local_meta | None)`` across all processes.
+
+    ``source`` is this process's partition (window or range share). Each
+    yielded global block is variant-sharded per ``plan.block_sharding``;
+    its global width is ``P * padded_local_width``, of which this
+    process materialized only its own slab. ``local_meta`` is None on
+    consensus steps where this process had no data left (its slab was
+    all-MISSING padding).
+
+    Every process MUST drain this iterator to the end — breaking out
+    early desynchronizes the consensus allgather across processes.
+    """
+    n_proc = jax.process_count()
+    n_dev = plan.mesh.devices.size
+    if n_dev % n_proc:
+        raise ValueError(
+            f"mesh of {n_dev} devices not divisible into {n_proc} "
+            "processes"
+        )
+    n_local_dev = n_dev // n_proc
+    w_local = padded_width(block_variants, n_local_dev, pack)
+    n = source.n_samples
+    if pack:
+        missing_slab = np.full((n, w_local), PACKED_MISSING, np.uint8)
+    else:
+        missing_slab = np.full((n, w_local), MISSING, GENOTYPE_DTYPE)
+    sharding = plan.block_sharding
+
+    it = stream_host_blocks(
+        source, block_variants, start_variant, prefetch=prefetch,
+        pad_multiple=n_local_dev, pack=pack, stats=stats,
+    )
+    try:
+        while True:
+            item = next(it, None)
+            if not bool(allgather(np.int32(item is not None)).any()):
+                return
+            slab, meta = item if item is not None else (missing_slab, None)
+            if slab.shape[1] != w_local:  # defensive: all slabs must agree
+                raise AssertionError(
+                    f"local slab width {slab.shape[1]} != agreed "
+                    f"{w_local}"
+                )
+            gblock = jax.make_array_from_process_local_data(sharding, slab)
+            yield gblock, meta
+    finally:
+        it.close()  # stop the producer thread on any exit path

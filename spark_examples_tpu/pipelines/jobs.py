@@ -15,6 +15,9 @@ from dataclasses import dataclass
 
 import numpy as np
 
+import jax
+
+from spark_examples_tpu.parallel.multihost import fetch_replicated
 from spark_examples_tpu.core.config import JobConfig
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.models.pca import fit_pca
@@ -41,7 +44,7 @@ class CoordsOutput:
 
 def similarity_matrix_job(job: JobConfig, source=None) -> SimilarityResult:
     result = run_similarity(job, source=source)
-    if job.output_path:
+    if job.output_path and jax.process_index() == 0:
         pio.write_matrix(job.output_path, result.sample_ids,
                          result.similarity, kind="similarity")
     return result
@@ -113,8 +116,8 @@ def pcoa_job(
             res = hard_sync(
                 fit_pcoa(dist.astype(np.float32), k=k, method=method)
             )
-        coords, vals = np.asarray(res.coords), np.asarray(res.eigenvalues)
-        prop = np.asarray(res.proportion_explained)
+        coords, vals = fetch_replicated(res.coords), fetch_replicated(res.eigenvalues)
+        prop = fetch_replicated(res.proportion_explained)
     _maybe_save_model(job, dist, coords, vals, sample_ids)
     return _emit_coords(job, sample_ids, coords, vals, timer, n_variants,
                         method=method, proportion=prop)
@@ -123,11 +126,11 @@ def pcoa_job(
 def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
     """Persist the fitted embedding when the job asks for it
     (pipelines/project.py consumes it to place new samples)."""
-    if not job.model_path:
+    if not job.model_path or jax.process_index() != 0:
         return
     from spark_examples_tpu.pipelines.project import save_model
 
-    save_model(job.model_path, coords, vals, np.asarray(dist),
+    save_model(job.model_path, coords, vals, fetch_replicated(dist),
                sample_ids, job.compute.metric or "ibs")
 
 
@@ -144,12 +147,14 @@ def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
                                        k=job.compute.num_pc,
                                        iters=eigh_iters))
     out = CoordsOutput(
-        sample_ids, np.asarray(coords), np.asarray(vals), timer,
+        sample_ids, fetch_replicated(coords), fetch_replicated(vals), timer,
         n_variants,
-        proportion=(np.asarray(proportion)
+        proportion=(fetch_replicated(proportion)
                     if proportion is not None else None),
     )
-    if job.output_path:
+    # Multi-host: exactly one process owns the output files (the
+    # reference's driver-writes-output contract).
+    if job.output_path and jax.process_index() == 0:
         pio.write_coords_tsv(job.output_path, sample_ids, out.coords)
     return out
 
@@ -203,12 +208,12 @@ def _pcoa_device_route(job: JobConfig, source, timer) -> CoordsOutput | None:
         method = _eigh_method(cfg.eigh_mode, dist.shape[0])
         with timer.phase("eigh"):
             res = hard_sync(fit_pcoa(dist, k=cfg.num_pc, method=method))
-        _maybe_save_model(job, np.asarray(dist), np.asarray(res.coords),
-                          np.asarray(res.eigenvalues), grun.sample_ids)
-    return _emit_coords(job, grun.sample_ids, np.asarray(res.coords),
-                        np.asarray(res.eigenvalues), timer,
+        _maybe_save_model(job, dist, fetch_replicated(res.coords),
+                          fetch_replicated(res.eigenvalues), grun.sample_ids)
+    return _emit_coords(job, grun.sample_ids, fetch_replicated(res.coords),
+                        fetch_replicated(res.eigenvalues), timer,
                         grun.n_variants, method=method,
-                        proportion=np.asarray(res.proportion_explained))
+                        proportion=fetch_replicated(res.proportion_explained))
 
 
 def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
@@ -269,8 +274,8 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
             res = pca_coords_sharded(plan, grun.acc, "shared-alt", k=k,
                                      iters=iters, timer=timer)
             return _emit_coords(job, grun.sample_ids,
-                                np.asarray(res.coords),
-                                np.asarray(res.eigenvalues), timer,
+                                fetch_replicated(res.coords),
+                                fetch_replicated(res.eigenvalues), timer,
                                 grun.n_variants, method="randomized",
                                 eigh_iters=iters)  # honest FLOP credit
         with timer.phase("finalize"):
@@ -283,12 +288,12 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
         # sim_dev passed as-is: the helper's early return keeps the
         # N x N matrix on device unless a model save actually needs it
         # (the route's contract: only (N, k) projections come home).
-        _maybe_save_pca_model(job, sim_dev, np.asarray(res.coords),
-                              np.asarray(res.eigenvalues),
+        _maybe_save_pca_model(job, sim_dev, fetch_replicated(res.coords),
+                              fetch_replicated(res.eigenvalues),
                               grun.sample_ids)
         return _emit_coords(job, grun.sample_ids,
-                            np.asarray(res.coords),
-                            np.asarray(res.eigenvalues), timer,
+                            fetch_replicated(res.coords),
+                            fetch_replicated(res.eigenvalues), timer,
                             grun.n_variants, method="dense")
 
     # cpu-reference backend only (the jax backend always returned above):
@@ -305,11 +310,11 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
 
 
 def _maybe_save_pca_model(job, similarity, coords, vals, sample_ids):
-    if not job.model_path:
+    if not job.model_path or jax.process_index() != 0:
         return  # before any np.asarray: no D2H unless actually saving
     from spark_examples_tpu.pipelines.project import save_pca_model
 
-    save_pca_model(job.model_path, coords, vals, np.asarray(similarity),
+    save_pca_model(job.model_path, coords, vals, fetch_replicated(similarity),
                    sample_ids)
 
 

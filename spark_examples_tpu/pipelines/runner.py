@@ -18,6 +18,7 @@ measured denominator of BASELINE.md.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from functools import partial
 
@@ -81,8 +82,22 @@ def build_source(cfg: IngestConfig):
     """IngestConfig -> GenotypeSource (the reference's L2/L3 factory),
     with QC and LD-prune stream transforms layered on per config
     (QC first — pruning monomorphic/high-missing variants is the QC
-    filter's job, and LD r^2 on them is undefined-ish anyway)."""
-    src = _build_raw_source(cfg)
+    filter's job, and LD r^2 on them is undefined-ish anyway).
+
+    Under ``jax.distributed`` (process_count > 1) the returned source is
+    this process's *partition* of the input — a genomic-range share for
+    ``--references``-driven file sources, a block-aligned variant window
+    otherwise — so each host reads only its slice (the reference's
+    one-partition-per-executor split). Stream transforms then apply
+    per-partition; for LD pruning that means windows do not see LD
+    context across partition boundaries (same contract as its existing
+    per-contig resets).
+    """
+    meshes.maybe_init_distributed()
+    if jax.process_count() > 1:
+        src = _build_local_partition(cfg)
+    else:
+        src = _build_raw_source(cfg)
     if cfg.maf > 0.0 or cfg.max_missing < 1.0:
         from spark_examples_tpu.ingest.filters import FilteredSource
 
@@ -95,6 +110,50 @@ def build_source(cfg: IngestConfig):
         src = LdPruneSource(src, r2=cfg.ld_r2, window=cfg.ld_window,
                             carry=carry)
     return src
+
+
+def _build_local_partition(cfg: IngestConfig):
+    """This process's share of the input (multi-host ingest partition).
+
+    File sources with ``--references``: each contig range is split into
+    ``process_count`` sub-ranges (partition_ranges — the reference's
+    FixedContigSplits applied across hosts) and this process keeps its
+    index's share of every contig. Random-access sources (synthetic
+    generation, memmapped packed/array stores): a block-aligned variant
+    window. Streaming file sources WITHOUT references would force every
+    process to parse the whole file just to discard most of it — that
+    defeats partitioned ingest, so it is rejected with the fix named.
+    """
+    from spark_examples_tpu.ingest.source import (
+        EmptyShare,
+        WindowSource,
+        partition_ranges,
+        window_for_process,
+    )
+
+    p, n_proc = jax.process_index(), jax.process_count()
+    if cfg.source in ("vcf", "plink") and cfg.references:
+        mine = []
+        for ref in cfg.references:
+            parts = partition_ranges([ref], n_proc)
+            mine.extend(parts[p::n_proc])
+        if not mine:
+            # references=[] would mean "no filter" (read EVERYTHING) —
+            # a process whose share came out empty must stream nothing.
+            return EmptyShare(_build_raw_source(cfg))
+        sub = dataclasses.replace(cfg, references=mine)
+        return _build_raw_source(sub)
+    if cfg.source == "vcf":
+        raise ValueError(
+            "multi-host VCF ingest needs --references so each process "
+            "can read only its genomic range; alternatively `pack` the "
+            "VCF once and run the job from the packed store"
+        )
+    src = _build_raw_source(cfg)
+    start, stop = window_for_process(
+        src.n_variants, cfg.block_variants, p, n_proc
+    )
+    return WindowSource(src, start, stop)
 
 
 def _build_raw_source(cfg: IngestConfig):
@@ -198,7 +257,7 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
     )
     if cfg.checkpoint_dir:
         restored = ckpt.load(cfg.checkpoint_dir, metric, source.sample_ids,
-                             block_variants=bv)
+                             block_variants=bv, plan=plan)
         if restored is not None:
             acc, start_variant, saved_stats = restored
             if stream_stats is not None:
@@ -206,9 +265,15 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
     if acc is None:
         acc = gram_sharded.init_sharded(plan, n, metric)
 
+    if jax.process_count() > 1:
+        return _finish_gram_multihost(
+            job, source, timer, plan, update, acc, start_variant, metric,
+            packed, stream_stats, on_block,
+        )
+
     # Variant-sharded placement needs the variant axis divisible by the
     # mesh size; padding with MISSING is semantically free.
-    n_shards = plan.mesh.devices.size if plan.mode == "variant" else 1
+    n_shards = plan.block_shards
     blocks_done = 0
     last_stop = start_variant
     with timer.phase("gram"):
@@ -234,12 +299,79 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
                 ckpt.save(
                     cfg.checkpoint_dir, acc, meta.stop, metric, bv,
                     source.sample_ids, stream_stats=stream_stats,
+                    plan=plan,
                 )
         acc = hard_sync(acc)
 
     # The stream already counted the variants (meta.stop of the final
     # block) — avoid source.n_variants, which for VCF may re-parse the file.
     n_variants = last_stop if last_stop > 0 else source.n_variants
+    _check_int32_budget(
+        metric, n_variants, (stream_stats or {}).get("max_value", 2)
+    )
+    return GramRun(acc, plan, source.sample_ids, metric, timer, n_variants)
+
+
+def _finish_gram_multihost(job, source, timer, plan, update, acc,
+                           start_variant, metric, packed, stream_stats,
+                           on_block) -> GramRun:
+    """The multi-host tail of run_gram: consensus-stepped streaming of
+    per-process partitions into the shared accumulator
+    (parallel/multihost.py). ``source`` is this process's partition
+    (build_source already windowed/range-split it); cursors and
+    checkpoints are per-process over the local partition."""
+    from spark_examples_tpu.parallel import multihost as mh
+
+    cfg = job.compute
+    n = source.n_samples
+    bv = job.ingest.block_variants
+    blocks_done = 0
+    last_stop = start_variant
+    with timer.phase("gram"):
+        for gblock, meta in mh.stream_global_blocks(
+            source, bv, start_variant, plan, packed, stats=stream_stats,
+            prefetch=job.ingest.prefetch_blocks,
+        ):
+            acc = update(acc, gblock)
+            blocks_done += 1
+            if meta is not None:
+                # FLOP/byte credit: this process's own share only (the
+                # per-process timers are per-host truths; the global
+                # numbers are their allgathered sums).
+                w_local = meta.stop - meta.start
+                timer.add("gram_flops",
+                          gram.flops_per_block(n, w_local, metric))
+                from spark_examples_tpu.ingest import bitpack
+
+                timer.add(
+                    "ingest_bytes",
+                    n * (bitpack.packed_width(w_local) if packed
+                         else w_local),
+                )
+                last_stop = meta.stop
+            if on_block is not None:
+                on_block(acc, blocks_done, meta)
+            if (
+                cfg.checkpoint_dir
+                and cfg.checkpoint_every_blocks
+                and blocks_done % cfg.checkpoint_every_blocks == 0
+            ):
+                hard_sync(acc)
+                ckpt.save(
+                    cfg.checkpoint_dir, acc, last_stop, metric, bv,
+                    source.sample_ids, stream_stats=stream_stats,
+                    plan=plan,
+                )
+        acc = hard_sync(acc)
+
+    # Global totals: sum of every process's partition.
+    n_variants = int(mh.allgather(np.int64(last_stop)).sum())
+    if stream_stats is not None:
+        stream_stats["max_value"] = int(
+            mh.allgather(
+                np.int64(stream_stats.get("max_value", 0))
+            ).max()
+        )
     _check_int32_budget(
         metric, n_variants, (stream_stats or {}).get("max_value", 2)
     )
@@ -265,9 +397,11 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
     g = run_gram(job, source, timer)
     with timer.phase("finalize"):
         out = hard_sync(_finalize_jit(g.acc, metric))
+    from spark_examples_tpu.parallel.multihost import fetch_replicated
+
     return SimilarityResult(
-        similarity=np.asarray(out["similarity"]),
-        distance=np.asarray(out["distance"]),
+        similarity=fetch_replicated(out["similarity"]),
+        distance=fetch_replicated(out["distance"]),
         sample_ids=g.sample_ids,
         metric=metric,
         timer=timer,

@@ -143,9 +143,16 @@ def incremental_pcoa_job(
         # plus the one being dispatched.
         "b": None,
         "b_variants": -1,
+        # Last local cursor seen — multi-host consensus steps where THIS
+        # process fed a padding slab pass meta=None, but the refresh jit
+        # is a collective program every process must still enter in
+        # lockstep (blocks_done is the shared consensus step count).
+        "last_stop": 0,
     }
 
     def on_block(acc, blocks_done, meta):
+        if meta is not None:
+            state["last_stop"] = meta.stop
         if blocks_done % refresh_every:
             return
         # Backpressure: materialize the PREVIOUS refresh's snapshot
@@ -177,8 +184,9 @@ def incremental_pcoa_job(
             b = center(acc)
             vals, vecs, q = refresh(b, state["q"])
             coords = coords_from_eigpairs(vals, vecs)
-        state.update(q=q, b=b, b_variants=meta.stop)
-        state["snapshots"].append(StreamSnapshot(meta.stop, vals, coords))
+        stop = state["last_stop"]
+        state.update(q=q, b=b, b_variants=stop)
+        state["snapshots"].append(StreamSnapshot(stop, vals, coords))
 
     grun = R.run_gram(job, source, timer, plan=plan, on_block=on_block)
     for snap in state["snapshots"]:

@@ -167,6 +167,82 @@ def test_checkpoint_resume(tmp_path, rng):
                                rtol=1e-6, atol=1e-7)
 
 
+def test_checkpoint_tile2d_sharded(tmp_path, rng):
+    """VERDICT r3 #2: the tile2d regime can checkpoint without defeating
+    the tiling — save writes one tile-shaped file per addressable shard
+    (never a full N x N leaf), load re-places each tile onto its device,
+    kill/resume matches clean bit-for-bit, and a tile-grid mismatch is
+    rejected."""
+    import dataclasses
+    import glob
+    import os
+
+    from spark_examples_tpu.core import checkpoint as ckpt
+    from spark_examples_tpu.core.profiling import PhaseTimer
+    from spark_examples_tpu.ops import gram
+    from spark_examples_tpu.parallel import gram_sharded
+    from spark_examples_tpu.parallel.pcoa_sharded import assert_tiled
+
+    g = random_genotypes(rng, 16, 1024, missing_rate=0.1)
+    ckpt_dir = str(tmp_path / "ck")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=128),
+        compute=ComputeConfig(metric="ibs", gram_mode="tile2d",
+                              checkpoint_dir=ckpt_dir,
+                              checkpoint_every_blocks=2),
+    )
+
+    class Dying(ArraySource):
+        def blocks(self, bv, start_variant=0):
+            for i, (b, m) in enumerate(super().blocks(bv, start_variant)):
+                if i == 4:
+                    raise RuntimeError("simulated preemption")
+                yield b, m
+
+    with pytest.raises(RuntimeError, match="preemption"):
+        runner.run_gram(job, Dying(g), PhaseTimer())
+
+    # On disk: one file per tile per leaf, each exactly tile-shaped —
+    # the full N x N leaf never materialized on any host or device.
+    plan = runner.plan_for_job(job, ArraySource(g))
+    ni, nj = plan.mesh.devices.shape
+    pieces = gram.PIECES_FOR_METRIC["ibs"]
+    tile_files = glob.glob(os.path.join(ckpt_dir, "*.t*_*.npy"))
+    assert len(tile_files) == ni * nj * len(pieces), tile_files
+    for f in tile_files:
+        assert np.load(f).shape == (16 // ni, 16 // nj), f
+    full_files = [
+        f for f in glob.glob(os.path.join(ckpt_dir, "*.npy"))
+        if f not in tile_files
+    ]
+    assert not full_files, full_files
+
+    # Resume under the SAME tile grid: every restored leaf is genuinely
+    # tiled, and the resumed accumulation equals the clean one exactly
+    # (integer counts).
+    resumed = runner.run_gram(job, ArraySource(g), PhaseTimer())
+    for k, v in resumed.acc.items():
+        assert_tiled(v, resumed.plan, f"restored accumulator {k}")
+    clean_job = job.replace(
+        compute=dataclasses.replace(job.compute, checkpoint_dir=None)
+    )
+    clean = runner.run_gram(clean_job, ArraySource(g), PhaseTimer())
+    for k in clean.acc:
+        np.testing.assert_array_equal(
+            np.asarray(resumed.acc[k]), np.asarray(clean.acc[k])
+        )
+
+    # Tile-grid mismatch: resuming the tiled checkpoint under a
+    # different plan must refuse, not silently re-tile.
+    other_plan = gram_sharded.GramPlan(plan.mesh, "variant")
+    with pytest.raises(ValueError, match="tile grid"):
+        ckpt.load(ckpt_dir, "ibs", ArraySource(g).sample_ids,
+                  block_variants=128, plan=other_plan)
+    with pytest.raises(ValueError, match="tiled leaf|tile grid"):
+        ckpt.load(ckpt_dir, "ibs", ArraySource(g).sample_ids,
+                  block_variants=128)
+
+
 def test_checkpoint_rejects_wrong_cohort(tmp_path, rng):
     from spark_examples_tpu.core import checkpoint as ckpt
 
