@@ -190,22 +190,57 @@ def gram_products(
     increment times the stream length stays under 2^31 (< 2^29 variants
     for dosage inputs, whose worst increment is 4).
     """
-    integer = np.issubdtype(np.dtype(accum_dtype), np.integer)
+    ops = _prepped_operands(block, accum_dtype)
+    spec = _product_spec(products, accum_dtype)
+    return _weighted_products(spec, ops, ops, accum_dtype)
+
+
+def _prepped_operands(block, accum_dtype) -> dict[str, jnp.ndarray]:
+    """Operands ready for the MXU: radix-128 split of ``qr`` on the
+    integer path (keeps every operand int8), accumulator-dtype cast on
+    the float path. Shared by the symmetric and tile product builders."""
     ops = operands(block)
-    if integer:
-        # Radix-128 split keeps every MXU operand int8.
+    if np.issubdtype(np.dtype(accum_dtype), np.integer):
         sq = ops.pop("qr")
         ops["qh"] = (sq >> 7).astype(jnp.int8)
         ops["ql"] = (sq & 127).astype(jnp.int8)
-        spec = {
-            p: _INT8_SPLIT.get(p, ((PRODUCT_OPERANDS[p], 1),))
-            for p in products
-        }
     else:
         dt = np.dtype(accum_dtype)
         ops = {k: v.astype(dt) for k, v in ops.items()}
-        spec = {p: ((PRODUCT_OPERANDS[p], 1),) for p in products}
-    return _weighted_products(spec, ops, ops, accum_dtype)
+    return ops
+
+
+def _product_spec(products: tuple[str, ...], accum_dtype):
+    """product -> weighted operand-pair terms, honoring the int8 split."""
+    if np.issubdtype(np.dtype(accum_dtype), np.integer):
+        return {
+            p: _INT8_SPLIT.get(p, ((PRODUCT_OPERANDS[p], 1),))
+            for p in products
+        }
+    return {p: ((PRODUCT_OPERANDS[p], 1),) for p in products}
+
+
+def tile_products(
+    block_rows: jnp.ndarray,
+    block_cols: jnp.ndarray,
+    products: tuple[str, ...],
+    accum_dtype=jnp.int32,
+) -> dict[str, jnp.ndarray]:
+    """:func:`gram_products` for one (rows, cols) tile of the pair
+    matrix: left operands from the row samples' slice of the block,
+    right operands from the column samples' — product[p] =
+    opL(rows) @ opR(cols)^T. The per-device building block of the
+    replicated-transport tile2d update (parallel/gram_sharded), where
+    each chip owns an (N/p_i, N/p_j) tile and slices both operand sets
+    locally out of the same on-device block. Feeding the same slice for
+    both sides reproduces ``gram_products`` exactly (pinned by
+    tests/test_genotype_ops.py)."""
+    return _weighted_products(
+        _product_spec(products, accum_dtype),
+        _prepped_operands(block_rows, accum_dtype),
+        _prepped_operands(block_cols, accum_dtype),
+        accum_dtype,
+    )
 
 
 def combine_products(

@@ -136,13 +136,20 @@ def _update_packed_impl(acc, packed, pieces: tuple[str, ...]):
     return _update_impl(acc, unpack_dosages(packed), pieces)
 
 
-def _update_grm_impl(acc: dict, block: jnp.ndarray, precise: bool = False) -> dict:
-    """VanRaden-form GRM accumulation with in-block allele frequencies.
+def grm_standardize(block: jnp.ndarray, precise: bool = False):
+    """VanRaden standardization of one dosage block: ``(z, keep)``.
 
-    ``precise``: run the Z Z^T product in f32 instead of bf16 — bf16
-    rounds GRM entries at ~1e-3 relative (the standardized dosages are
-    continuous, unlike the exact {0,1} indicators of the counting
-    metrics); f32 matmuls run at roughly half MXU rate.
+    Per-variant allele frequency estimated *within the block*, dosages
+    centered by 2p and scaled by 1/sqrt(2p(1-p)), missing mean-imputed
+    to zero contribution; ``keep`` masks variants with usable
+    frequencies (kept count feeds the GRM denominator). The single
+    definition shared by the dense update here and the tile2d shard_map
+    body (parallel/gram_sharded) — the two must never diverge.
+
+    ``precise``: emit f32 ``z`` instead of bf16 — bf16 rounds GRM
+    entries at ~1e-3 relative (the standardized dosages are continuous,
+    unlike the exact {0,1} indicators of the counting metrics); f32
+    matmuls run at roughly half MXU rate.
     """
     p, cnt, y, valid = genotype.af_stats(block)
     denom = 2.0 * p * (1.0 - p)
@@ -150,6 +157,12 @@ def _update_grm_impl(acc: dict, block: jnp.ndarray, precise: bool = False) -> di
     scale = jnp.where(keep, jax.lax.rsqrt(jnp.maximum(denom, 1e-8)), 0.0)
     dt = jnp.float32 if precise else COMPUTE_DTYPE
     z = jnp.where(valid, (y - 2.0 * p) * scale, 0.0).astype(dt)
+    return z, keep
+
+
+def _update_grm_impl(acc: dict, block: jnp.ndarray, precise: bool = False) -> dict:
+    """VanRaden-form GRM accumulation (see :func:`grm_standardize`)."""
+    z, keep = grm_standardize(block, precise)
     zz = jax.lax.dot_general(
         z, z, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )

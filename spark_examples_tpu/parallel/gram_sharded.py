@@ -14,14 +14,27 @@ is sharding annotations on the *same* jitted computation
   N x N Gram on-device" the north star prescribes (BASELINE.json:5).
 - **tile2d mode** (the 76k-exome regime, BASELINE.md config 4): the
   accumulator is tiled (rows over mesh axis i, cols over j) so each chip
-  holds an (N/p_i, N/p_j) tile; blocks arrive variant-sharded (each chip
-  is fed 1/n_dev of the block over the host link) and XLA all-gathers
-  the block over ICI before each chip contracts its row-slice against
-  its col-slice — host→device traffic per chip drops by n_dev, and the
-  gather rides ICI, which is orders of magnitude faster than the host
-  link. This is also exactly the transport the multi-host path needs:
-  each process feeds only its own variant slice
-  (parallel/multihost.py).
+  holds an (N/p_i, N/p_j) tile. Two block transports exist, chosen by
+  ``make_update(block_layout=...)``:
+
+  * ``"sharded"`` (default — host-streamed blocks): blocks arrive
+    variant-sharded (each chip is fed 1/n_dev of the block over the
+    host link) and XLA all-gathers the block over ICI before each chip
+    contracts its row-slice against its col-slice — host→device traffic
+    per chip drops by n_dev, and the gather rides ICI, orders of
+    magnitude faster than the host link. This is also exactly the
+    transport the multi-host path needs: each process feeds only its
+    own variant slice (parallel/multihost.py). The gather IS a
+    collective in the hot loop; at 76k x 4096 int8 it moves ~0.3 GB/
+    block over ICI (~3 ms at v5e ICI rates) against ~10^13 FLOPs of
+    tile matmuls — <2 % of the update (BASELINE.md config 4).
+  * ``"replicated"`` (staged/on-device blocks): the block is already
+    fully present on every chip (generated on device, or staged once),
+    each chip slices its row/col operands locally, and the hot loop
+    runs with NO collectives at all — chips are independent between
+    checkpoints. ``tests/test_parallel.py`` compile-checks this claim
+    (no all-gather/all-to-all in the lowered update). This is the
+    layout the config-4 per-chip projection assumes.
 - **replicated mode**: single-chip degenerate case (mesh (1,1)).
 
 Mode choice is automatic from accumulator-memory footprint unless forced.
@@ -65,6 +78,9 @@ class GramPlan:
         # tile2d mode XLA all-gathers the shards over ICI inside the
         # update — either way each chip's host link carries 1/n_dev of
         # every block, and each *process* can feed only its own slice.
+        # Blocks already resident on-device take the "replicated" layout
+        # instead (make_update(block_layout="replicated")) and skip the
+        # gather entirely.
         if self.mode in ("variant", "tile2d"):
             return meshes.variants_flat(self.mesh)
         return meshes.replicated(self.mesh)
@@ -109,38 +125,170 @@ def init_sharded(plan: GramPlan, n: int, metric: str):
     return {k: jax.device_put(v, shardings[k]) for k, v in acc.items()}
 
 
+def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
+                           grm_precise: bool, gather_block: bool):
+    """The tile2d update as an explicit shard_map, for both transports.
+
+    Relying on jit + sharding annotations here lets XLA's SPMD
+    partitioner pick pathological lowerings (observed on the CPU mesh):
+    for the replicated layout it re-shards the indicator intermediates
+    and all-gathers them back; for the variant-sharded layout it
+    computes PARTIAL tiles per variant shard and all-REDUCES them —
+    tile_area x 4 B x n_pieces of ICI traffic per block (11.6 GB at the
+    76k config-4 shape) instead of the one (N, v) block gather (~80 MB
+    packed) the design intends. shard_map makes the choreography
+    explicit:
+
+    - ``gather_block=True`` (variant-sharded transport): one
+      ``all_gather`` of the (packed) block over the flattened mesh —
+      the hot loop's ONLY collective, gathered in the 2-bit domain when
+      the stream is packed so it costs n*v/4 bytes;
+    - ``gather_block=False`` (replicated/staged transport): no
+      collective at all.
+
+    Either way each device then slices its row/col sample ranges out of
+    the full block and contracts them locally with
+    :func:`genotype.tile_products`. Compile-checked by
+    tests/test_parallel.py.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from spark_examples_tpu.ops import genotype
+
+    mesh = plan.mesh
+    n_i, n_j = mesh.devices.shape
+    if metric == "grm":
+        acc_specs = {"zz": P(meshes.AXIS_I, meshes.AXIS_J), "nvar": P()}
+    else:
+        acc_specs = {
+            k: P(meshes.AXIS_I, meshes.AXIS_J)
+            for k in gram_ops.PIECES_FOR_METRIC[metric]
+        }
+    block_spec = (
+        P(None, (meshes.AXIS_I, meshes.AXIS_J)) if gather_block else P()
+    )
+
+    def body(acc, block):
+        if gather_block:
+            # One explicit gather of the variant shards (i major, j
+            # minor — the same order P(None, ("i", "j")) split them).
+            block = jax.lax.all_gather(
+                block, (meshes.AXIS_I, meshes.AXIS_J), axis=1, tiled=True
+            )
+        if packed:
+            from spark_examples_tpu.ingest.bitpack import unpack_dosages
+
+            block = unpack_dosages(block)
+        i = jax.lax.axis_index(meshes.AXIS_I)
+        j = jax.lax.axis_index(meshes.AXIS_J)
+        n = block.shape[0]
+        tn, tm = n // n_i, n // n_j
+        if metric == "grm":
+            # Standardization statistics come from the FULL block (per-
+            # variant, over all N samples — replicated work, identical
+            # on every device), then only the tile's slices hit the MXU.
+            z, keep = gram_ops.grm_standardize(block, grm_precise)
+            zr = jax.lax.dynamic_slice_in_dim(z, i * tn, tn, axis=0)
+            zc = jax.lax.dynamic_slice_in_dim(z, j * tm, tm, axis=0)
+            zz = jax.lax.dot_general(
+                zr, zc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return {"zz": acc["zz"] + zz, "nvar": acc["nvar"] + keep.sum()}
+        rows = jax.lax.dynamic_slice_in_dim(block, i * tn, tn, axis=0)
+        cols = jax.lax.dynamic_slice_in_dim(block, j * tm, tm, axis=0)
+        prods = genotype.tile_products(rows, cols, tuple(acc_specs))
+        return {k: acc[k] + prods[k] for k in acc_specs}
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(acc_specs, block_spec),
+        out_specs=acc_specs, check_vma=False,
+    )
+
+
 @lru_cache(maxsize=64)
 def _jitted_update(plan: GramPlan, metric: str, packed: bool,
-                   grm_precise: bool = False):
-    """One jit wrapper per (plan, metric, packed, grm_precise) —
+                   grm_precise: bool = False, block_layout: str = "sharded"):
+    """One jit wrapper per (plan, metric, packed, grm_precise, layout) —
     re-entering the same job shape reuses the compiled executable instead
     of re-tracing (a fresh ``jax.jit`` object owns a fresh compilation
     cache)."""
     acc_sh = _acc_shardings(plan, metric)
+    if plan.mode == "tile2d" and plan.mesh.devices.size > 1:
+        gather = block_layout == "sharded"
+        return jax.jit(
+            _tile2d_shard_map_impl(plan, metric, packed, grm_precise,
+                                   gather_block=gather),
+            in_shardings=(
+                acc_sh,
+                plan.block_sharding if gather
+                else meshes.replicated(plan.mesh),
+            ),
+            out_shardings=acc_sh,
+            donate_argnums=(0,),
+        )
+    block_sh = (
+        meshes.replicated(plan.mesh) if block_layout == "replicated"
+        else plan.block_sharding
+    )
     return jax.jit(
         gram_ops.impl_for(metric, packed, grm_precise),
-        in_shardings=(acc_sh, plan.block_sharding),
+        in_shardings=(acc_sh, block_sh),
         out_shardings=acc_sh,
         donate_argnums=(0,),
     )
 
 
 def make_update(plan: GramPlan, metric: str, packed: bool = False,
-                grm_precise: bool = False):
+                grm_precise: bool = False, block_layout: str = "sharded"):
     """Jitted ``(acc, block) -> acc`` with the plan's shardings pinned.
 
-    The computation is byte-identical to the single-chip path; only the
-    sharding annotations differ. XLA SPMD inserts the psum (variant mode)
-    or slices the dots (tile2d) — no hand-written collectives, per the
-    mesh/annotate/let-XLA-insert recipe.
+    The computation is byte-identical to the single-chip path. Variant
+    mode follows the mesh/annotate/let-XLA-insert recipe (the psum over
+    variant shards is exactly the collective wanted there); tile2d mode
+    is an explicit shard_map (:func:`_tile2d_shard_map_impl`) because
+    the SPMD partitioner, left to its own choice, picked pathological
+    collective patterns for it (see that function's docstring).
 
     ``packed``: blocks arrive 2-bit packed ((N, v_blk/4) uint8,
     ingest/bitpack.py) and are unpacked per-shard on device — in variant
     mode the packed byte axis is what gets sharded, so each chip unpacks
     only its own quarter-width slice.
+
+    ``block_layout``: how blocks reach the update. ``"sharded"`` (the
+    host-streamed transport) shards the variant axis across the mesh —
+    in tile2d mode XLA all-gathers it over ICI inside the update.
+    ``"replicated"`` declares the block already fully present on every
+    device (staged/on-device generation): tile2d chips then slice their
+    operands locally and the hot loop compiles with NO collectives
+    (compile-checked by tests/test_parallel.py). Only meaningful for
+    tile2d; variant mode's psum is its compute, not its transport, so
+    replicated blocks are rejected there rather than silently computing
+    the whole N x N redundantly on every chip.
     """
-    jitted = _jitted_update(plan, metric, packed, grm_precise)
+    if block_layout not in ("sharded", "replicated"):
+        raise ValueError(f"unknown block_layout {block_layout!r}")
+    if block_layout == "replicated" and plan.mode == "variant":
+        raise ValueError(
+            "block_layout='replicated' under a variant-mode plan would "
+            "make every chip compute the full N x N product redundantly "
+            "— use the sharded transport (or a tile2d plan)"
+        )
+    jitted = _jitted_update(plan, metric, packed, grm_precise, block_layout)
     n_shards = plan.block_shards
+    if block_layout == "replicated":
+        want_sharding = meshes.replicated(plan.mesh)
+
+        def update_replicated(acc, block):
+            if not (
+                isinstance(block, jax.Array)
+                and block.sharding == want_sharding
+            ):
+                block = jax.device_put(np.asarray(block), want_sharding)
+            return jitted(acc, block)
+
+        return update_replicated
 
     def update(acc, block):
         if not (isinstance(block, jax.Array) and block.sharding == plan.block_sharding):

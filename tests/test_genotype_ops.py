@@ -109,3 +109,26 @@ def test_grm_matches_naive(genotypes):
     want = oracle.naive_grm(genotypes)
     # bf16 standardized dosages: tolerance, not exactness.
     np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+
+def test_tile_products_match_gram_products(genotypes):
+    """tile_products on a (rows, cols) split must reproduce the same
+    sub-blocks gram_products computes for the full block — the parity
+    contract of the replicated-transport tile2d update."""
+    from spark_examples_tpu.ops.genotype import gram_products, tile_products
+
+    products = ("cc", "yc", "t1t1", "t2t2", "qc", "yy")
+    full = {k: np.asarray(v) for k, v in
+            gram_products(genotypes, products).items()}
+    rows, cols = genotypes[:16], genotypes[16:]
+    tile = tile_products(rows, cols, products)
+    for k in products:
+        np.testing.assert_array_equal(
+            np.asarray(tile[k]), full[k][:16, 16:], err_msg=k
+        )
+    # Same slice on both sides == the full product's diagonal block.
+    sym = tile_products(rows, rows, products)
+    for k in products:
+        np.testing.assert_array_equal(
+            np.asarray(sym[k]), full[k][:16, :16], err_msg=k
+        )

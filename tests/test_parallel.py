@@ -296,3 +296,74 @@ def test_sharded_end_to_end_pcoa(rng, mesh):
         np.abs(np.asarray(res.coords)), np.abs(np.asarray(ref.coords)),
         rtol=1e-3, atol=1e-4,
     )
+
+
+@pytest.mark.parametrize("metric", ["ibs", "grm"])
+def test_tile2d_replicated_block_layout_matches(rng, mesh, metric):
+    """The staged/on-device transport: replicated blocks into a tile2d
+    accumulation produce the same result as the sharded transport."""
+    g = random_genotypes(rng, n=32, v=256, missing_rate=0.1)
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    acc = gram_sharded.init_sharded(plan, 32, metric)
+    update = gram_sharded.make_update(plan, metric,
+                                      block_layout="replicated")
+    for s in range(0, 256, 64):
+        acc = update(acc, g[:, s : s + 64])
+    want = _single_device_reference(g, metric)
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(acc[k]), want[k], rtol=1e-5, atol=1e-5, err_msg=k
+        )
+
+
+def test_tile2d_replicated_layout_compiles_without_collectives(mesh):
+    """The config-4 projection's premise, compile-checked: with blocks
+    already resident on every device (block_layout="replicated"), the
+    tile2d hot-loop update lowers to purely local slicing + matmuls —
+    no all-gather / all-to-all / collective-permute anywhere. (The
+    default "sharded" transport, by contrast, all-gathers each block
+    over ICI — asserted below so the documented trade-off tracks the
+    code. An all-REDUCE never belongs in either tile2d lowering: tiles
+    are disjoint, nothing sums across devices — and left to the SPMD
+    partitioner's own choice it DID pick a partial-tile all-reduce,
+    tile_area x 4 B x pieces of traffic per block, which is why both
+    transports are explicit shard_maps.)"""
+    from spark_examples_tpu.parallel.gram_sharded import (
+        _acc_shardings, _jitted_update,
+    )
+
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    n, v = 32, 64
+    acc_spec = {
+        k: jax.ShapeDtypeStruct((n, n), np.int32)
+        for k in gram.PIECES_FOR_METRIC["ibs"]
+    }
+    blk_spec = jax.ShapeDtypeStruct((n, v), np.int8)
+
+    def hlo(layout):
+        jitted = _jitted_update(plan, "ibs", False, False, layout)
+        return jitted.lower(acc_spec, blk_spec).compile().as_text()
+
+    collectives = ("all-gather", "all-to-all", "collective-permute",
+                   "all-reduce")
+    replicated = hlo("replicated")
+    assert not any(c in replicated for c in collectives), (
+        "replicated-layout tile2d update must have no collectives in "
+        "the hot loop"
+    )
+    sharded = hlo("sharded")
+    assert "all-gather" in sharded, (
+        "sharded-layout tile2d update is expected to all-gather the "
+        "block over ICI (the documented host-link trade-off)"
+    )
+    assert "all-reduce" not in sharded, (
+        "a partial-tile all-reduce crept back into the sharded tile2d "
+        "update — that is tile_area x 4 B x pieces of ICI traffic per "
+        "block instead of one block gather"
+    )
+
+
+def test_replicated_block_layout_rejected_for_variant_mode(mesh):
+    plan = gram_sharded.GramPlan(mesh, "variant")
+    with pytest.raises(ValueError, match="redundantly"):
+        gram_sharded.make_update(plan, "ibs", block_layout="replicated")
