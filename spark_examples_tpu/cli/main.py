@@ -32,10 +32,11 @@ from spark_examples_tpu.core.config import (
 def _add_common(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("ingest")
     g.add_argument("--source", default="synthetic",
-                   choices=["synthetic", "vcf", "packed", "plink"])
+                   choices=["synthetic", "vcf", "packed", "plink", "parquet"])
     g.add_argument("--path", default=None,
                    help="input for vcf (.vcf/.vcf.gz), packed (store "
-                   "dir), or plink (fileset prefix or .bed path) sources")
+                   "dir), plink (fileset prefix or .bed path), or "
+                   "parquet (.parquet variant table) sources")
     g.add_argument("--references", nargs="*", default=[],
                    metavar="CONTIG:START:END",
                    help="genomic ranges to ingest (VCF region filter)")
@@ -93,7 +94,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     c.add_argument("--grm-precise", action="store_true",
                    help="accumulate the GRM's Z Z^T in f32 instead of "
                    "bf16 (half MXU rate, ~1e-3 better accuracy)")
-    c.add_argument("--checkpoint-dir", default=None)
+    c.add_argument(
+        "--checkpoint-dir", default=None,
+        help="directory for partial-Gram checkpoint/resume; multi-host "
+        "jobs REQUIRE this to be on a filesystem shared by every "
+        "process (each process writes its own tiles; process 0 "
+        "rotates after a barrier)",
+    )
     c.add_argument("--checkpoint-every-blocks", type=int, default=0)
     p.add_argument("--output-path", default=None)
     p.add_argument("--timings", action="store_true",
@@ -209,7 +216,8 @@ def main(argv: list[str] | None = None) -> int:
     p_proj.add_argument("--model", required=True,
                         help=".npz from pcoa --save-model")
     p_proj.add_argument("--ref-source", default="plink",
-                        choices=["synthetic", "vcf", "packed", "plink"],
+                        choices=["synthetic", "vcf", "packed", "plink",
+                                 "parquet"],
                         help="reference cohort genotypes (the panel the "
                         "model was fitted on)")
     p_proj.add_argument("--ref-path", default=None)
@@ -222,7 +230,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     _add_common(p_ck)  # --source/--path describe the NEW cohort
     p_ck.add_argument("--ref-source", default="plink",
-                      choices=["synthetic", "vcf", "packed", "plink"])
+                      choices=["synthetic", "vcf", "packed", "plink",
+                               "parquet"])
     p_ck.add_argument("--ref-path", default=None)
     p_ck.add_argument("--min-phi", type=float, default=0.177,
                       help="console report threshold (0.177 ~ the "

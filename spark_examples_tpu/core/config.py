@@ -51,7 +51,7 @@ class ReferenceRange:
 class IngestConfig:
     """Which variants to stream, from where, in what block shape."""
 
-    source: str = "synthetic"  # synthetic | vcf | packed
+    source: str = "synthetic"  # synthetic | vcf | packed | plink | parquet
     path: str | None = None  # file path for vcf/packed sources
     references: list[ReferenceRange] = field(default_factory=list)
     n_samples: int = 2504  # synthetic default: 1000 Genomes phase-3 cohort

@@ -1,6 +1,7 @@
 from spark_examples_tpu.ingest import (  # noqa: F401
     bitpack,
     packed,
+    parquet,
     plink,
     prefetch,
     source,
@@ -22,6 +23,10 @@ from spark_examples_tpu.ingest.source import (  # noqa: F401
     ChainSource,
     GenotypeSource,
     partition_ranges,
+)
+from spark_examples_tpu.ingest.parquet import (  # noqa: F401
+    ParquetSource,
+    write_parquet,
 )
 from spark_examples_tpu.ingest.synthetic import SyntheticSource  # noqa: F401
 from spark_examples_tpu.ingest.vcf import VcfSource, write_vcf  # noqa: F401

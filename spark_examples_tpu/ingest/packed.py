@@ -115,6 +115,15 @@ class Packed2BitSource:
             return self.ids
         return [f"S{i:06d}" for i in range(self.n_samples)]
 
+    @property
+    def exact_n_variants(self) -> bool:
+        """Single-run stores stream exactly ceil(v/bv) blocks on both
+        transports; multi-contig stores' DENSE blocks flush at each
+        chromosome run (packed_blocks would be exact, but the claim
+        must hold for whichever transport the consumer picks — see the
+        GenotypeSource contract), so they conservatively decline."""
+        return self.contig_runs is None or len(self.contig_runs) <= 1
+
     def _contig_of(self, lo: int, hi: int) -> str | None:
         """Contig of the variant range [lo, hi) — None when the range
         spans a run boundary (multi-contig stores pack continuously, so

@@ -41,7 +41,20 @@ class BlockMeta:
 
 @runtime_checkable
 class GenotypeSource(Protocol):
-    """The ingest contract: sample axis fixed, variant axis streamed."""
+    """The ingest contract: sample axis fixed, variant axis streamed.
+
+    Optional attribute ``exact_n_variants`` (absent == False): when
+    True, the source guarantees that (a) ``n_variants`` is cheap and
+    exact, and (b) ``blocks(bv, start)`` yields **exactly**
+    ``ceil((n_variants - start) / bv)`` blocks for any block-aligned
+    ``start``, on both transports — i.e. no early flushes at contig
+    boundaries. The multi-host feeder uses this to precompute the
+    global step count in one allgather (parallel/multihost.py); a
+    source that flushes partial blocks mid-stream (multi-contig dense
+    stores, ChainSource) must NOT claim it — the feeder trusts the
+    claim and raises on a mismatch rather than silently dropping
+    variants.
+    """
 
     @property
     def n_samples(self) -> int: ...
@@ -153,6 +166,8 @@ class ArraySource:
     ingestion path (SURVEY.md §2.1 "BigQuery ingestion path").
     """
 
+    exact_n_variants = True  # the array's shape is the count
+
     genotypes: np.ndarray  # (N, V) int8
     ids: list[str] | None = None
     contig: str | None = None
@@ -225,6 +240,12 @@ class WindowSource:
     @property
     def n_variants(self) -> int:
         return self.stop - self.start
+
+    @property
+    def exact_n_variants(self) -> bool:
+        # The window bounds are exact iff the inner count they were cut
+        # from is (a filtered inner source could under-produce).
+        return bool(getattr(self.inner, "exact_n_variants", False))
 
     @property
     def sample_ids(self) -> list[str]:
@@ -307,6 +328,8 @@ class EmptyShare:
 
     inner: GenotypeSource
 
+    exact_n_variants = True  # zero, exactly
+
     @property
     def n_samples(self) -> int:
         return self.inner.n_samples
@@ -364,6 +387,10 @@ class ChainSource:
     @property
     def n_variants(self) -> int:
         return sum(p.n_variants for p in self.parts)
+
+    # NOT exact_n_variants: blocks() restarts the grid at every part
+    # boundary (a partial tail block per part), so the stream's block
+    # count is not ceil(total / bv) unless every part happens to align.
 
     @property
     def sample_ids(self) -> list[str]:

@@ -27,6 +27,10 @@ _CHUNK = 1024
 
 @dataclass
 class SyntheticSource:
+    # Deterministic generation: the variant count is exact and free to
+    # read (multi-host feeder precomputes step counts from it).
+    exact_n_variants = True
+
     n_samples: int = 2504
     n_variants: int = 100_000
     n_populations: int = 5

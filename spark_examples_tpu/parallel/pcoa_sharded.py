@@ -142,7 +142,7 @@ def pca_coords_sharded(
     metric: str = "shared-alt",
     k: int = 10,
     key: jax.Array | None = None,
-    oversample: int = 16,
+    oversample: int = 32,
     iters: int = 6,
     check_shardings: bool = True,
     timer=None,
@@ -187,8 +187,8 @@ def pcoa_coords_sharded(
     metric: str,
     k: int = 10,
     key: jax.Array | None = None,
-    oversample: int = 16,
-    iters: int = 4,
+    oversample: int = 32,
+    iters: int = 8,
     check_shardings: bool = True,
     timer=None,
 ) -> PCoAResult:

@@ -136,7 +136,7 @@ def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
 
 def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
                  n_variants: int, method: str,
-                 eigh_iters: int = 4, proportion=None) -> CoordsOutput:
+                 eigh_iters: int = 8, proportion=None) -> CoordsOutput:
     """Shared output tail of every PCoA route: solver-matched FLOP
     credit, result assembly, optional TSV persistence. ``eigh_iters``
     must match the randomized solver's actual iteration count (the

@@ -132,7 +132,7 @@ def _build_local_partition(cfg: IngestConfig):
     )
 
     p, n_proc = jax.process_index(), jax.process_count()
-    if cfg.source in ("vcf", "plink") and cfg.references:
+    if cfg.source in ("vcf", "plink", "parquet") and cfg.references:
         mine = []
         for ref in cfg.references:
             parts = partition_ranges([ref], n_proc)
@@ -143,11 +143,11 @@ def _build_local_partition(cfg: IngestConfig):
             return EmptyShare(_build_raw_source(cfg))
         sub = dataclasses.replace(cfg, references=mine)
         return _build_raw_source(sub)
-    if cfg.source == "vcf":
+    if cfg.source in ("vcf", "parquet"):
         raise ValueError(
-            "multi-host VCF ingest needs --references so each process "
-            "can read only its genomic range; alternatively `pack` the "
-            "VCF once and run the job from the packed store"
+            f"multi-host {cfg.source} ingest needs --references so each "
+            "process can read only its genomic range; alternatively "
+            "`pack` the file once and run the job from the packed store"
         )
     src = _build_raw_source(cfg)
     start, stop = window_for_process(
@@ -179,6 +179,12 @@ def _build_raw_source(cfg: IngestConfig):
                 ".bed path)"
             )
         return _maybe_partitioned(PlinkSource, cfg)
+    if cfg.source == "parquet":
+        if not cfg.path:
+            raise ValueError("parquet source requires ingest.path")
+        from spark_examples_tpu.ingest.parquet import ParquetSource
+
+        return _maybe_partitioned(ParquetSource, cfg)
     raise ValueError(f"unknown source {cfg.source!r}")
 
 

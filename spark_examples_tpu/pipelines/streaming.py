@@ -43,7 +43,7 @@ from spark_examples_tpu.parallel.gram_sharded import GramPlan, _acc_shardings
 from spark_examples_tpu.pipelines import runner as R
 from spark_examples_tpu.pipelines.jobs import CoordsOutput, _emit_coords
 
-OVERSAMPLE = 16
+OVERSAMPLE = 32  # matches randomized_eigh's default subspace width
 FINAL_ITERS = 4  # tightening steps for the terminal solve
 
 
@@ -203,6 +203,10 @@ def incremental_pcoa_job(
         vals, vecs, _q = hard_sync(final(b, state["q"]))
     v = np.asarray(vals)
     coords = np.asarray(coords_from_eigpairs(vals, vecs))
+    # eigh_iters must mirror the terminal solve actually run — the
+    # _emit_coords default tracks randomized_eigh's cold-start defaults,
+    # not this warm path's tightening count.
     out = _emit_coords(job, grun.sample_ids, coords, v, timer,
-                       grun.n_variants, method="randomized")
+                       grun.n_variants, method="randomized",
+                       eigh_iters=FINAL_ITERS)
     return out, state["snapshots"]

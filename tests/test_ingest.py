@@ -292,3 +292,115 @@ def test_partitioned_vcf_pipeline_parity(tmp_path, genotypes):
         compute=ComputeConfig(metric="ibs")))
     np.testing.assert_array_equal(r_seq.similarity, r_par.similarity)
     assert r_seq.n_variants == r_par.n_variants
+
+
+def test_parquet_roundtrip(tmp_path, genotypes):
+    """Wide parquet variant table (the BigQuery-export stand-in) round-
+    trips exactly, streams in steady blocks, resumes mid-stream, and
+    reports an exact length from file metadata alone."""
+    from spark_examples_tpu.ingest.parquet import ParquetSource, write_parquet
+
+    path = str(tmp_path / "cohort.parquet")
+    write_parquet(path, genotypes, row_group_rows=64)
+    src = ParquetSource(path)
+    n, v = genotypes.shape
+    assert src.n_samples == n
+    assert src.exact_n_variants
+    assert src.n_variants == v
+    got = np.concatenate([b for b, _ in src.blocks(50)], axis=1)
+    np.testing.assert_array_equal(got, genotypes)
+    metas = [m for _, m in src.blocks(50)]
+    assert [m.start for m in metas] == list(range(0, v, 50))
+    assert metas[0].contig == "chr22"
+    assert metas[0].positions is not None
+    # Resume from a produced cursor.
+    tail = np.concatenate([b for b, _ in src.blocks(50, metas[1].stop)], axis=1)
+    np.testing.assert_array_equal(tail, genotypes[:, metas[1].stop:])
+
+
+def test_parquet_region_filter_and_job(tmp_path, rng):
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest.parquet import ParquetSource, write_parquet
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+
+    g = random_genotypes(rng, n=12, v=300, missing_rate=0.05)
+    path = str(tmp_path / "cohort.parquet")
+    write_parquet(path, g, contig="chr1", start_pos=100, row_group_rows=128)
+    half = ParquetSource(
+        path, references=[ReferenceRange("chr1", 100, 100 + 150)],
+    )
+    assert not half.exact_n_variants  # filtered: count needs a scan
+    assert half.n_variants == 150
+    got = np.concatenate([b for b, _ in half.blocks(64)], axis=1)
+    np.testing.assert_array_equal(got, g[:, :150])
+
+    # The job surface accepts source="parquet" end to end.
+    job = JobConfig(
+        ingest=IngestConfig(source="parquet", path=path, block_variants=64),
+        compute=ComputeConfig(metric="ibs", num_pc=3),
+    )
+    out = pcoa_job(job)
+    want = pcoa_job(
+        JobConfig(ingest=IngestConfig(block_variants=64),
+                  compute=ComputeConfig(metric="ibs", num_pc=3)),
+        source=ArraySource(g),
+    )
+    np.testing.assert_allclose(
+        np.abs(out.coords), np.abs(want.coords), atol=1e-4
+    )
+
+
+def test_parquet_multi_contig_blocks_never_span(tmp_path, rng):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_examples_tpu.ingest.parquet import ParquetSource
+
+    g = random_genotypes(rng, n=6, v=100, missing_rate=0.0)
+    contigs = ["chr1"] * 37 + ["chr2"] * 63
+    cols = {"contig": pa.array(contigs),
+            "position": pa.array(np.arange(100, dtype=np.int64))}
+    for i in range(6):
+        cols[f"S{i}"] = pa.array(np.asarray(g[i], np.int8))
+    pq.write_table(pa.table(cols), str(tmp_path / "mc.parquet"),
+                   row_group_size=40)
+    src = ParquetSource(str(tmp_path / "mc.parquet"))
+    # Multi-contig: dense blocks flush at the chr1/chr2 boundary, so the
+    # steady ceil-count contract cannot be claimed.
+    assert not src.exact_n_variants
+    blocks = list(src.blocks(25))
+    for _, m in blocks:
+        assert m.contig in ("chr1", "chr2")
+    # The chr1/chr2 boundary at 37 forces a partial flush there.
+    stops = [m.stop for _, m in blocks]
+    assert 37 in stops
+    got = np.concatenate([b for b, _ in blocks], axis=1)
+    np.testing.assert_array_equal(got, g)
+
+
+def test_packed_store_exactness_claim(tmp_path, genotypes):
+    """The exact_n_variants contract (steady ceil-count blocks on BOTH
+    transports): single-run stores claim it, multi-contig stores must
+    decline — their dense blocks flush at each chromosome run, so the
+    multi-host feeder cannot precompute their step count."""
+    from spark_examples_tpu.ingest.packed import Packed2BitSource, save_packed
+
+    path = str(tmp_path / "store")
+    save_packed(path, genotypes)
+    from spark_examples_tpu.ingest.packed import load_packed
+
+    single = load_packed(path)
+    assert single.exact_n_variants
+    multi = Packed2BitSource(
+        packed=single.packed, v=single.v,
+        contig_runs=[("chr1", 0), ("chr2", 100)],
+    )
+    assert not multi.exact_n_variants
+    # And the feeder helper honors the declination.
+    from spark_examples_tpu.parallel.multihost import _exact_local_steps
+
+    assert _exact_local_steps(multi, 64, 0) == -1
+    assert _exact_local_steps(single, 64, 0) == -(-single.v // 64)
