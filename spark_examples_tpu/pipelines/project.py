@@ -150,16 +150,20 @@ class CrossPlan:
         return meshes.replicated(self.mesh)
 
     @property
-    def new_block_sharding(self) -> NamedSharding:
+    def new_block_sharding(self) -> NamedSharding | None:
+        # None = default single-device placement: in replicated mode the
+        # update runs on one chip, and a replicated device_put would
+        # multiply the ingest-bound host->device traffic by the device
+        # count for nothing.
         if self.mode == "tile2d":
             return meshes.rows_i(self.mesh)
-        return meshes.replicated(self.mesh)
+        return None
 
     @property
-    def ref_block_sharding(self) -> NamedSharding:
+    def ref_block_sharding(self) -> NamedSharding | None:
         if self.mode == "tile2d":
             return meshes.rows_j(self.mesh)
-        return meshes.replicated(self.mesh)
+        return None
 
 
 def cross_plan_for(

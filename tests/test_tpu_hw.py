@@ -97,7 +97,7 @@ try:
     import jax
 
     if jax.default_backend() != "tpu":
-        # The 30 TFLOP/s floor is calibrated for a TPU MXU; running it
+        # The TFLOP/s floor is calibrated for a TPU MXU; running it
         # on cpu OR another accelerator (a CUDA dev box) would fail
         # spuriously.
         print(json.dumps(
@@ -150,11 +150,13 @@ print(json.dumps({
 
 def test_gram_throughput_floor_on_tpu():
     """Regression gate for the int8 gram lowering: the staged update
-    must clear 120 TFLOP/s on real hardware. Sessions measure 150-280
-    (staged/config-4); an f32 fallback halves MXU rate (~80-140 at
-    best) and a VPU lowering loses orders of magnitude — both land
-    under the gate, while observed session-to-session variance
-    (150-191 staged across rounds) stays above it. The round-3/4 gate
+    must clear 145 TFLOP/s on real hardware. At this shape sessions
+    measure 155-285 TFLOP/s; v5e MXU peaks are 394 int8 TOPS / 197
+    bf16 TFLOPS / ~99 f32, so at the observed ~72-78 % efficiency a
+    silent bf16 downgrade tops out ~142-154 (caught in all but the
+    very fastest regressed sessions), an f32 downgrade ~70-77, and a
+    VPU lowering loses orders of magnitude — all under the gate, while
+    every observed healthy session stays above it. The round-3/4 gate
     of 30 TFLOP/s could not tell a real lowering regression from
     variance, which was its entire job (VERDICT r4 weak #3). One retry
     absorbs transient tunnel blips mid-benchmark (observed ~1-in-10
@@ -170,7 +172,7 @@ def test_gram_throughput_floor_on_tpu():
                 raise
     if "skip" in out:
         pytest.skip(out["skip"])
-    assert out["tflops"] > 120.0, out
+    assert out["tflops"] > 145.0, out
 
 
 _BC_PERF_SCRIPT = r"""
