@@ -21,6 +21,7 @@ import sys
 import numpy as np
 
 from spark_examples_tpu.version import __version__  # noqa: F401 - CLI flag
+from spark_examples_tpu.core import config
 from spark_examples_tpu.core.config import (
     ComputeConfig,
     IngestConfig,
@@ -84,6 +85,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "replicated", "variant", "tile2d"])
     c.add_argument("--eigh-mode", default="auto",
                    choices=["auto", "dense", "randomized"])
+    c.add_argument("--eigh-iters", type=int,
+                   default=config.EIGH_ITERS_DEFAULT,
+                   help="randomized solver power iterations (default "
+                   "meets the documented accuracy contract; see "
+                   "BASELINE.md)")
+    c.add_argument("--eigh-oversample", type=int,
+                   default=config.EIGH_OVERSAMPLE_DEFAULT,
+                   help="randomized solver subspace oversample (k+p "
+                   "probe columns)")
     c.add_argument("--braycurtis-method", default="auto",
                    choices=["auto", "exact", "matmul", "pallas"],
                    help="braycurtis lowering: auto (pallas on an "
@@ -145,6 +155,8 @@ def _job_from_args(args) -> JobConfig:
             mesh_shape=mesh_shape,
             gram_mode=args.gram_mode,
             eigh_mode=args.eigh_mode,
+            eigh_iters=args.eigh_iters,
+            eigh_oversample=args.eigh_oversample,
             braycurtis_method=args.braycurtis_method,
             braycurtis_levels=args.braycurtis_levels,
             grm_precise=args.grm_precise,

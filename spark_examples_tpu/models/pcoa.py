@@ -15,6 +15,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from spark_examples_tpu.core.config import (
+    EIGH_ITERS_DEFAULT,
+    EIGH_OVERSAMPLE_DEFAULT,
+)
 from spark_examples_tpu.ops.centering import gower_center
 from spark_examples_tpu.ops.eigh import (
     coords_from_eigpairs,
@@ -30,14 +34,15 @@ class PCoAResult:
     proportion_explained: jnp.ndarray  # (k,) fraction of positive inertia
 
 
-@partial(jax.jit, static_argnames=("k", "method"))
-def _fit(distance, k, method, key):
+@partial(jax.jit, static_argnames=("k", "method", "iters", "oversample"))
+def _fit(distance, k, method, key, iters, oversample):
     b = gower_center(distance)
     trace = jnp.trace(b)  # total inertia = sum of all eigenvalues
     if method == "dense":
         vals, vecs = top_k_eigh(b, k)
     else:
-        vals, vecs = randomized_eigh(b, k, key)
+        vals, vecs = randomized_eigh(b, k, key, oversample=oversample,
+                                     iters=iters)
     coords = coords_from_eigpairs(vals, vecs)
     prop = jnp.maximum(vals, 0.0) / jnp.maximum(trace, 1e-30)
     return coords, vals, prop
@@ -48,9 +53,19 @@ def fit_pcoa(
     k: int = 10,
     method: str = "dense",
     key: jax.Array | None = None,
+    iters: int = EIGH_ITERS_DEFAULT,
+    oversample: int = EIGH_OVERSAMPLE_DEFAULT,
 ) -> PCoAResult:
-    """PCoA on an (N, N) distance matrix. ``method``: dense | randomized."""
+    """PCoA on an (N, N) distance matrix. ``method``: dense | randomized
+    (``iters``/``oversample`` tune the randomized solver — the
+    ``--eigh-iters``/``--eigh-oversample`` CLI knobs; ignored by
+    dense)."""
     if key is None:
         key = jax.random.key(0)
-    coords, vals, prop = _fit(distance, k, method, key)
+    if method == "dense":
+        # The knobs don't reach the dense solver, but as static jit args
+        # distinct values would still retrace/recompile the full N x N
+        # eigh program for a bit-identical result — normalize them.
+        iters, oversample = 0, 0
+    coords, vals, prop = _fit(distance, k, method, key, iters, oversample)
     return PCoAResult(coords, vals, prop)

@@ -22,6 +22,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from spark_examples_tpu.core.config import (
+    EIGH_ITERS_DEFAULT,
+    EIGH_OVERSAMPLE_DEFAULT,
+)
+
 
 @partial(jax.jit, static_argnames=("k",))
 def top_k_eigh(b: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -101,8 +106,8 @@ def randomized_eigh(
     b: jnp.ndarray,
     k: int,
     key: jax.Array,
-    oversample: int = 32,
-    iters: int = 8,
+    oversample: int = EIGH_OVERSAMPLE_DEFAULT,
+    iters: int = EIGH_ITERS_DEFAULT,
     select: str = "top",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Randomized top-k eigenpairs of symmetric ``b``.
@@ -135,8 +140,9 @@ def randomized_eigh(
 
 
 def eigh_flops(
-    n: int, method: str = "dense", k: int = 0, oversample: int = 32,
-    iters: int = 8,
+    n: int, method: str = "dense", k: int = 0,
+    oversample: int = EIGH_OVERSAMPLE_DEFAULT,
+    iters: int = EIGH_ITERS_DEFAULT,
 ) -> float:
     """FLOP estimate matching the solver actually run, for the
     eigh-GFLOPS/chip north-star metric (BASELINE.md).

@@ -30,6 +30,10 @@ import jax
 import jax.numpy as jnp
 
 from spark_examples_tpu.core import meshes
+from spark_examples_tpu.core.config import (
+    EIGH_ITERS_DEFAULT,
+    EIGH_OVERSAMPLE_DEFAULT,
+)
 from spark_examples_tpu.models.pca import PCAResult
 from spark_examples_tpu.models.pcoa import PCoAResult
 from spark_examples_tpu.ops import distances
@@ -142,7 +146,7 @@ def pca_coords_sharded(
     metric: str = "shared-alt",
     k: int = 10,
     key: jax.Array | None = None,
-    oversample: int = 32,
+    oversample: int = EIGH_OVERSAMPLE_DEFAULT,
     iters: int = 6,
     check_shardings: bool = True,
     timer=None,
@@ -187,8 +191,8 @@ def pcoa_coords_sharded(
     metric: str,
     k: int = 10,
     key: jax.Array | None = None,
-    oversample: int = 32,
-    iters: int = 8,
+    oversample: int = EIGH_OVERSAMPLE_DEFAULT,
+    iters: int = EIGH_ITERS_DEFAULT,
     check_shardings: bool = True,
     timer=None,
 ) -> PCoAResult:

@@ -18,7 +18,7 @@ import numpy as np
 import jax
 
 from spark_examples_tpu.parallel.multihost import fetch_replicated
-from spark_examples_tpu.core.config import JobConfig
+from spark_examples_tpu.core.config import EIGH_ITERS_DEFAULT, JobConfig
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.models.pca import fit_pca
 from spark_examples_tpu.models.pcoa import fit_pcoa
@@ -114,13 +114,16 @@ def pcoa_job(
         method = _eigh_method(job.compute.eigh_mode, n)
         with timer.phase("eigh"):
             res = hard_sync(
-                fit_pcoa(dist.astype(np.float32), k=k, method=method)
+                fit_pcoa(dist.astype(np.float32), k=k, method=method,
+                         iters=job.compute.eigh_iters,
+                         oversample=job.compute.eigh_oversample)
             )
         coords, vals = fetch_replicated(res.coords), fetch_replicated(res.eigenvalues)
         prop = fetch_replicated(res.proportion_explained)
     _maybe_save_model(job, dist, coords, vals, sample_ids)
     return _emit_coords(job, sample_ids, coords, vals, timer, n_variants,
-                        method=method, proportion=prop)
+                        method=method, eigh_iters=job.compute.eigh_iters,
+                        proportion=prop)
 
 
 def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
@@ -136,7 +139,8 @@ def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
 
 def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
                  n_variants: int, method: str,
-                 eigh_iters: int = 8, proportion=None) -> CoordsOutput:
+                 eigh_iters: int = EIGH_ITERS_DEFAULT,
+                 proportion=None) -> CoordsOutput:
     """Shared output tail of every PCoA route: solver-matched FLOP
     credit, result assembly, optional TSV persistence. ``eigh_iters``
     must match the randomized solver's actual iteration count (the
@@ -198,7 +202,8 @@ def _pcoa_device_route(job: JobConfig, source, timer) -> CoordsOutput | None:
     grun = runner.run_gram(job, source, timer, plan=plan)
     if plan.mode == "tile2d":
         res = pcoa_coords_sharded(plan, grun.acc, metric, k=cfg.num_pc,
-                                  timer=timer)
+                                  oversample=cfg.eigh_oversample,
+                                  iters=cfg.eigh_iters, timer=timer)
         method = "randomized"
     else:
         with timer.phase("finalize"):
@@ -207,12 +212,15 @@ def _pcoa_device_route(job: JobConfig, source, timer) -> CoordsOutput | None:
             )
         method = _eigh_method(cfg.eigh_mode, dist.shape[0])
         with timer.phase("eigh"):
-            res = hard_sync(fit_pcoa(dist, k=cfg.num_pc, method=method))
+            res = hard_sync(fit_pcoa(dist, k=cfg.num_pc, method=method,
+                                     iters=cfg.eigh_iters,
+                                     oversample=cfg.eigh_oversample))
         _maybe_save_model(job, dist, fetch_replicated(res.coords),
                           fetch_replicated(res.eigenvalues), grun.sample_ids)
     return _emit_coords(job, grun.sample_ids, fetch_replicated(res.coords),
                         fetch_replicated(res.eigenvalues), timer,
                         grun.n_variants, method=method,
+                        eigh_iters=cfg.eigh_iters,
                         proportion=fetch_replicated(res.proportion_explained))
 
 
@@ -270,8 +278,9 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
                 pca_coords_sharded,
             )
 
-            iters = 6  # explicit so the FLOP credit below can't drift
+            iters = job.compute.eigh_iters
             res = pca_coords_sharded(plan, grun.acc, "shared-alt", k=k,
+                                     oversample=job.compute.eigh_oversample,
                                      iters=iters, timer=timer)
             return _emit_coords(job, grun.sample_ids,
                                 fetch_replicated(res.coords),

@@ -166,3 +166,23 @@ def test_cli_pack_with_ld_prune(tmp_path, capsys):
                "--ld-prune-r2", "0.3", "--ld-window", "20",
                "--block-variants", "16", "--output-path", store)
     assert "x 30 variants" in cap.out  # every duplicate pruned
+
+
+def test_cli_eigh_knobs(tmp_path, capsys):
+    """--eigh-iters/--eigh-oversample thread into the randomized solver;
+    a generous setting still recovers the dense answer."""
+    out1 = str(tmp_path / "c1.tsv")
+    out2 = str(tmp_path / "c2.tsv")
+    _run(capsys, "pcoa", *BASE, "--num-pc", "2", "--eigh-mode", "dense",
+         "--output-path", out1)
+    _run(capsys, "pcoa", *BASE, "--num-pc", "2",
+         "--eigh-mode", "randomized", "--eigh-iters", "16",
+         "--eigh-oversample", "16", "--output-path", out2)
+
+    def coords(p):
+        rows = [r.split("\t")[1:] for r in
+                open(p).read().strip().splitlines()[1:]]
+        return np.abs(np.asarray(rows, float))
+
+    np.testing.assert_allclose(coords(out2), coords(out1),
+                               rtol=5e-2, atol=1e-3)

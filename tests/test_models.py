@@ -72,3 +72,33 @@ def test_pca_equivalent_to_mllib_route(rng):
             np.allclose(got[:, c], want[:, c], atol=1e-2)
             or np.allclose(got[:, c], -want[:, c], atol=1e-2)
         ), f"component {c} mismatch"
+
+
+def test_fit_pcoa_randomized_knobs(rng):
+    """iters/oversample overrides reach the solver: structure
+    eigenvalues match dense, and more iterations never worsen the
+    worst-case eigenvalue error."""
+    from tests.conftest import random_genotypes
+
+    from spark_examples_tpu.models.pcoa import fit_pcoa
+    from spark_examples_tpu.ops import distances, gram
+
+    g = random_genotypes(rng, n=64, v=2048, missing_rate=0.05)
+    acc = gram.update(gram.init(64, "ibs"), g, "ibs")
+    dist = np.asarray(distances.finalize(acc, "ibs")["distance"])
+    dense = np.asarray(fit_pcoa(dist, k=6).eigenvalues)
+
+    def err(iters):
+        vals = np.asarray(
+            fit_pcoa(dist, k=6, method="randomized", iters=iters,
+                     oversample=16).eigenvalues
+        )
+        return np.abs((vals - dense) / np.maximum(np.abs(dense), 1e-12)).max()
+
+    assert err(24) <= err(2) + 1e-6
+    # Structure (well-separated) eigenvalues are tight even at few iters.
+    vals4 = np.asarray(
+        fit_pcoa(dist, k=6, method="randomized", iters=8).eigenvalues
+    )
+    top = dense > 0.05 * dense[0]
+    np.testing.assert_allclose(vals4[top], dense[top], rtol=2e-3)
