@@ -64,6 +64,38 @@ def allgather(value) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(np.asarray(value)))
 
 
+def allreduce_sum(x: np.ndarray) -> np.ndarray:
+    """Sum one per-process host array across processes ON DEVICE (one
+    XLA all-reduce riding DCN) and return the summed host value.
+
+    The data-plane companion to :func:`allgather`, whose contract is
+    small control-plane values only: gathering a (A, N_ref) statistic
+    matrix would materialize P copies on every host and move P times
+    the bytes, where the reduce moves one array's worth per link and
+    peaks at one extra copy. Requires identical shape/dtype on every
+    process; integer dtypes keep integer (wraparound) semantics, so
+    callers own the same overflow budget as any int32 accumulation.
+    """
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    x = np.asarray(x)
+    by_proc = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, d)
+    mesh = Mesh(
+        np.asarray([by_proc[p] for p in sorted(by_proc)]), ("p",)
+    )
+    sharding = NamedSharding(mesh, P("p"))
+    g = jax.make_array_from_process_local_data(sharding, x[None])
+    out = jax.jit(
+        lambda t: t.sum(axis=0),
+        out_shardings=NamedSharding(mesh, P()),
+    )(g)
+    return np.asarray(out.addressable_data(0))
+
+
 def fetch_replicated(x):
     """``np.asarray`` that tolerates process-spanning arrays.
 

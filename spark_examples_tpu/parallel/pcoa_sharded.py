@@ -147,7 +147,7 @@ def pca_coords_sharded(
     k: int = 10,
     key: jax.Array | None = None,
     oversample: int = EIGH_OVERSAMPLE_DEFAULT,
-    iters: int = 6,
+    iters: int = EIGH_ITERS_DEFAULT,
     check_shardings: bool = True,
     timer=None,
 ) -> PCAResult:

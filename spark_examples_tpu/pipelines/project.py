@@ -175,10 +175,23 @@ def cross_plan_for(
     (same threshold as the symmetric planner); tiling requires both
     sample axes divisible by their mesh axis — the replicated fallback
     is chosen otherwise (an uneven tile grid would need shard_map
-    padding nothing currently justifies).
+    padding nothing currently justifies). Multi-host jobs always run
+    replicated (per-process accumulation over each ingest partition,
+    one additive merge at the end): the tile2d transport row-shards
+    blocks over a process-spanning mesh, which per-process partitioned
+    streams cannot feed — auto never selects it there, and asking for
+    it explicitly is refused with the remedy named.
     """
     n_i, n_j = mesh.devices.shape
     divisible = a % n_i == 0 and n_ref % n_j == 0
+    multihost = jax.process_count() > 1
+    if mode == "tile2d" and multihost:
+        raise ValueError(
+            "the tile2d cross plan is single-host; multi-host cross "
+            "jobs run replicated (per-process accumulation, additive "
+            "merge) — use --gram-mode replicated (or auto), or run on "
+            "one host to tile across its chips"
+        )
     if mode == "variant":
         # The symmetric planner's variant mode has no cross analogue
         # (there is no psum-merged replicated product here) — a job
@@ -191,7 +204,7 @@ def cross_plan_for(
         acc_bytes = 4 * a * n_ref * max(1, n_stats)
         mode = (
             "tile2d"
-            if mesh.devices.size > 1 and divisible
+            if not multihost and mesh.devices.size > 1 and divisible
             and acc_bytes > _ACC_BUDGET
             else "replicated"
         )
@@ -314,6 +327,7 @@ def _accumulate_cross(job, source_new, source_ref,
     Returns (accumulators, n_variants); under a tile2d ``plan`` the
     accumulators stay tiled across the mesh (no full (A, N_ref) leaf on
     any device — verified per job by an assert_tiled check)."""
+    multihost = jax.process_count() > 1
     a = source_new.n_samples
     n_ref = source_ref.n_samples
     bv = job.ingest.block_variants
@@ -321,6 +335,14 @@ def _accumulate_cross(job, source_new, source_ref,
         plan = cross_plan_for(
             meshes.make_mesh(shape=job.compute.mesh_shape), a, n_ref,
             len(stats), job.compute.gram_mode,
+        )
+    if multihost and plan.mode == "tile2d":
+        # Defensive: cross_plan_for already refuses this (auto never
+        # selects it multi-host); only a hand-built CrossPlan can get
+        # here, and proceeding would corrupt the accumulation.
+        raise ValueError(
+            "the tile2d cross plan is single-host; multi-host cross "
+            "jobs run replicated"
         )
     if plan.mode == "tile2d":
         update = _cross_update_tiled(plan, tuple(stats))
@@ -377,7 +399,7 @@ def _accumulate_cross(job, source_new, source_ref,
                     f"new/reference positions differ in block "
                     f"[{mn.start}, {mn.stop}) — not the same variant set"
                 )
-            acc = _update_cross(acc, bn, br)
+            acc = update(acc, bn, br)
             moment_blocks.append(_af_moments(bn, br))
             timer.add("gram_flops",
                       2.0 * a * n_ref * bn.shape[1] * n_matmuls)
@@ -389,12 +411,35 @@ def _accumulate_cross(job, source_new, source_ref,
 
         for k, v in acc.items():
             assert_tiled(v, plan, f"cross accumulator {k!r}")
-    if moment_blocks:
-        # One stacked fetch, then a float64 host reduction — per-block
-        # f32 values are small and exact-ish; the cross-block sums (and
-        # the cancellation-prone variance terms downstream) are not.
-        stacked = np.asarray(jnp.stack(moment_blocks), np.float64)
-        _check_af_concordance(stacked.sum(axis=0), a, n_ref)
+    # One stacked fetch, then a float64 host reduction — per-block
+    # f32 values are small and exact-ish; the cross-block sums (and
+    # the cancellation-prone variance terms downstream) are not.
+    moments = (
+        np.asarray(jnp.stack(moment_blocks), np.float64).sum(axis=0)
+        if moment_blocks else np.zeros(6, np.float64)
+    )
+    if multihost:
+        # Additive cross-process merge — the cross path's analogue of
+        # the symmetric gram's psum: every process accumulated only its
+        # variant partition, and every statistic here is a sum over
+        # variants. The matrices ride a device-side all-reduce (one
+        # array's worth of DCN traffic, not P host copies); the merged
+        # counts fit int32 whenever the job's budget does (the caller's
+        # _check_int32_budget sees the merged n_variants). Processes
+        # with empty partitions carry zero accumulators and MUST still
+        # enter these collectives. The (6,) moment vector stays on the
+        # control-plane allgather: jax's default f32 would round its
+        # f64 cancellation-prone sums.
+        from spark_examples_tpu.parallel import multihost as mh
+
+        acc = {
+            k: jnp.asarray(mh.allreduce_sum(np.asarray(v)))
+            for k, v in acc.items()
+        }
+        n_variants = int(mh.allgather(np.int64(n_variants)).sum())
+        moments = mh.allgather(moments).sum(axis=0)
+    if moments[0] > 0:
+        _check_af_concordance(moments, a, n_ref)
     return acc, n_variants
 
 
@@ -426,7 +471,9 @@ def cross_kinship_job(job, source_new, source_ref):
         phi = np.asarray(hard_sync(_cross_phi(
             acc["hh"], acc["opp"], acc["hcn"], acc["hcr"]
         )))
-    if job.output_path:
+    if job.output_path and jax.process_index() == 0:
+        # Multi-host: the merged statistics are identical on every
+        # process; exactly one owns the output files.
         pio.write_matrix(job.output_path, source_new.sample_ids, phi,
                          kind="similarity",
                          col_ids=source_ref.sample_ids)
@@ -539,6 +586,6 @@ def pcoa_project_job(
             )))
     out = CoordsOutput(source_new.sample_ids, coords,
                        np.asarray(eigvals), timer, n_variants)
-    if job.output_path:
+    if job.output_path and jax.process_index() == 0:
         pio.write_coords_tsv(job.output_path, out.sample_ids, out.coords)
     return out

@@ -523,3 +523,103 @@ def test_two_process_incremental_pcoa(mode):
         assert o["snapshots"] == 1, o
         got = np.asarray(o["coords"])
         assert float(np.max(np.abs(got - want))) < 1e-3, o
+
+
+# Multi-host cross-cohort jobs: each process accumulates its variant
+# partition's (A, N_ref) statistics locally, then one additive
+# cross-process merge reproduces the single-host result exactly; the
+# unsupported tile2d cross plan refuses up front instead of corrupting.
+_CROSS_WORKER = r"""
+import json, os, tempfile
+import numpy as np
+
+from spark_examples_tpu.core.virtual import force_virtual_cpu
+force_virtual_cpu(2)
+
+import jax
+
+from spark_examples_tpu.core.config import (
+    ComputeConfig, IngestConfig, JobConfig,
+)
+from spark_examples_tpu.pipelines.project import cross_kinship_job
+from spark_examples_tpu.pipelines.runner import build_source
+
+ingest_new = IngestConfig(source="synthetic", n_samples=8, n_variants=1280,
+                          block_variants=256, seed=5)
+ingest_ref = IngestConfig(source="synthetic", n_samples=8, n_variants=1280,
+                          block_variants=256, seed=5)
+job = JobConfig(ingest=ingest_new, compute=ComputeConfig(metric="king"))
+src_new = build_source(ingest_new)   # per-process window
+src_ref = build_source(ingest_ref)
+assert jax.process_count() == 2
+res = cross_kinship_job(job, src_new, src_ref)
+print(json.dumps({
+    "process": jax.process_index(),
+    "local_variants": int(src_new.n_variants),
+    "n_variants": int(res.n_variants),
+    "phi": np.asarray(res.similarity).tolist(),
+}))
+"""
+
+
+def test_two_process_cross_kinship_matches_single():
+    outs = _run_two_process(_CROSS_WORKER)
+
+    import numpy as np
+
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest.synthetic import SyntheticSource
+    from spark_examples_tpu.pipelines.project import cross_kinship_job
+
+    src = SyntheticSource(n_samples=8, n_variants=1280, seed=5)
+    job = JobConfig(ingest=IngestConfig(block_variants=256),
+                    compute=ComputeConfig(metric="king"))
+    want = cross_kinship_job(job, src,
+                             SyntheticSource(n_samples=8, n_variants=1280,
+                                             seed=5)).similarity
+    locals_ = sorted(o["local_variants"] for o in outs)
+    assert locals_ == [512, 768], locals_  # genuinely partitioned
+    for o in outs:
+        assert o["n_variants"] == 1280, o  # merged global count
+        np.testing.assert_array_equal(np.asarray(o["phi"]), want)
+    # Same individuals in both cohorts -> diagonal phi ~ 0.5.
+    assert (np.diag(want) > 0.45).all()
+
+
+_CROSS_TILE2D_GUARD = r"""
+import json
+import numpy as np
+
+from spark_examples_tpu.core.virtual import force_virtual_cpu
+force_virtual_cpu(2)
+
+import jax
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.core.config import (
+    ComputeConfig, IngestConfig, JobConfig,
+)
+from spark_examples_tpu.core.profiling import PhaseTimer
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.pipelines.project import _accumulate_cross
+
+meshes.maybe_init_distributed()
+assert jax.process_count() == 2
+g = np.zeros((8, 64), np.int8)
+job = JobConfig(ingest=IngestConfig(block_variants=32),
+                compute=ComputeConfig(metric="ibs", gram_mode="tile2d"))
+try:
+    _accumulate_cross(job, ArraySource(g), ArraySource(g), ("m", "d1"),
+                      PhaseTimer())
+    outcome = "ran"
+except ValueError as e:
+    outcome = "refused" if "single-host" in str(e) else f"wrong: {e}"
+print(json.dumps({"process": jax.process_index(), "outcome": outcome}))
+"""
+
+
+def test_cross_tile2d_refuses_multihost():
+    outs = _run_two_process(_CROSS_TILE2D_GUARD)
+    assert all(o["outcome"] == "refused" for o in outs), outs
