@@ -43,7 +43,7 @@ Mode choice is automatic from accumulator-memory footprint unless forced.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import numpy as np

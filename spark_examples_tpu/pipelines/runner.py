@@ -30,7 +30,6 @@ from spark_examples_tpu.core import meshes
 from spark_examples_tpu.core.config import IngestConfig, JobConfig
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.ingest import (
-    ArraySource,
     PlinkSource,
     SyntheticSource,
     VcfSource,

@@ -332,8 +332,19 @@ def drain(source):
 
 exact = drain(src)
 fallback = drain(HiddenLength(src))
+
+# Partial-group tail: 5 local steps under consensus_every=8 -> ONE
+# group of 8 steps (5 real + 3 all-padding), then the terminal gather.
+small_start, small_stop = window_for_process(1280, BV, jax.process_index(),
+                                             jax.process_count())
+small = HiddenLength(WindowSource(
+    SyntheticSource(n_samples=N, n_variants=1280, seed=11),
+    small_start, small_stop,
+))
+partial = drain(small)
 print(json.dumps({"process": jax.process_index(),
-                  "exact": exact, "fallback": fallback}))
+                  "exact": exact, "fallback": fallback,
+                  "partial": partial}))
 """
 
 
@@ -350,6 +361,12 @@ def test_feeder_consensus_amortization():
         assert o["fallback"]["rounds"] == 1 + 64 // 8 + 1, o
         assert o["fallback"]["blocks"] == 64, o
         assert o["fallback"]["global_width"] == o["exact"]["global_width"], o
+        # A group that outlives the data pads to the group boundary:
+        # 5 real steps -> 8 yielded (3 missing-slab), 3 rounds total
+        # (upfront count probe + group has-data + terminal).
+        assert o["partial"]["blocks"] == 8, o
+        assert o["partial"]["real"] == 5, o
+        assert o["partial"]["rounds"] == 3, o
 
 
 # VERDICT r5 task 6: multi-host checkpoint/resume. Both processes
