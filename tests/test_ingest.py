@@ -404,3 +404,36 @@ def test_packed_store_exactness_claim(tmp_path, genotypes):
 
     assert _exact_local_steps(multi, 64, 0) == -1
     assert _exact_local_steps(single, 64, 0) == -(-single.v // 64)
+
+
+def test_parquet_schema_errors(tmp_path, rng):
+    """Malformed tables fail loudly with the defect named."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_examples_tpu.ingest.parquet import ParquetSource
+
+    # Metadata-only table: no sample columns.
+    meta_only = str(tmp_path / "meta.parquet")
+    pq.write_table(pa.table({
+        "contig": pa.array(["chr1"] * 4),
+        "position": pa.array(np.arange(4, dtype=np.int64)),
+    }), meta_only)
+    with pytest.raises(ValueError, match="no sample columns"):
+        ParquetSource(meta_only).sample_ids
+
+    # Range filtering without contig/position columns.
+    from spark_examples_tpu.ingest.parquet import write_parquet
+
+    g = random_genotypes(rng, n=4, v=16, missing_rate=0.0)
+    bare = str(tmp_path / "bare.parquet")
+    write_parquet(bare, g, contig=None)
+    src = ParquetSource(bare,
+                        references=[ReferenceRange("chr1", 0, 10)])
+    with pytest.raises(ValueError, match="filtering needs"):
+        list(src.blocks(8))
+    # Without a filter the bare table streams fine (contig-less).
+    got = np.concatenate(
+        [b for b, _ in ParquetSource(bare).blocks(8)], axis=1
+    )
+    np.testing.assert_array_equal(got, g)
