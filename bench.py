@@ -561,11 +561,12 @@ def bench_tile_solve() -> dict:
     - the skinny replicated ops (QR of the (N, k+p) subspace, run at
       full N=76000 on EVERY chip in the real solve) are re-measured at
       the true 76k shape and the delta is added;
-    - the mesh collectives (row/col-mean psums — (N,) vectors, ~300 KB;
-      the B @ Q partial psum over j — (38000, 42) f32 ~ 6 MB/iter; the
-      centering mesh transpose ~ one tile) ride ICI and are noted, not
-      measured — at <10 MB/iteration they are noise next to the
-      2.9 GB/stage tile traffic.
+    - the mesh collectives ride ICI and are noted, not measured: the
+      eigh-phase ones are small (row/col-mean psums ~300 KB; B @ Q
+      partial psum over j ~6 MB/iter), and the finalize-phase combine
+      transposes (yc + yc^T) move one ~2.9 GB tile each over the mesh
+      once per solve — tens of ms at ICI rates, against the ~21 s gram
+      phase they follow.
 
     The synthetic accumulators carry plausible count magnitudes (m ~ V
     with ibs pieces below it) so finalize's integer->float path runs on
@@ -644,8 +645,10 @@ def bench_tile_solve() -> dict:
         "k": k, "oversample": oversample, "iters": iters,
         "note": (
             "actual sharded route on a (1,1) tile2d plan at the "
-            "per-chip workload; mesh collectives (<10 MB/iter over "
-            "ICI) noted, not measured"
+            "per-chip workload; un-proxied mesh collectives: small "
+            "eigh-phase psums (<10 MB/iter) plus one ~2.9 GB tile "
+            "transpose per combine in finalize (tens of ms at ICI "
+            "rates)"
         ),
     }
 
