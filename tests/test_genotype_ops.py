@@ -132,3 +132,46 @@ def test_tile_products_match_gram_products(genotypes):
         np.testing.assert_array_equal(
             np.asarray(sym[k]), full[k][:16, :16], err_msg=k
         )
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_metric_parity_fuzz(seed):
+    """Randomized-shape parity sweep: every gram metric's full
+    accumulate→combine→finalize chain must match the naive CPU oracle
+    bit-for-bit (int paths) or to float tolerance (grm), across odd
+    shapes, block grids, and missing rates — the pair-count→matmul
+    reformulation is the framework's core parity risk (SURVEY.md §7
+    hard part 1), so it gets adversarial shapes, not just the fixtures.
+    """
+    from spark_examples_tpu.ops import distances
+    from spark_examples_tpu.utils import oracle
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(3, 41))
+    v = int(rng.integers(7, 400))
+    bv = int(rng.integers(3, v + 1))
+    miss = float(rng.uniform(0.0, 0.4))
+    g = random_genotypes(rng, n=n, v=v, missing_rate=miss)
+
+    for metric in ("ibs", "ibs2", "shared-alt", "euclidean", "dot", "king"):
+        acc = gram.init(n, metric)
+        for s in range(0, v, bv):
+            acc = gram.update(acc, g[:, s:s + bv], metric)
+        got = {k: np.asarray(val)
+               for k, val in distances.finalize(acc, metric).items()}
+        prods = oracle.cpu_gram_products(
+            g, gram.PIECES_FOR_METRIC[metric]
+        )
+        want = oracle.cpu_finalize(
+            gram.combine(
+                {k: np.asarray(p, np.int64) for k, p in prods.items()},
+                metric,
+            ),
+            metric,
+        )
+        for field in ("similarity", "distance"):
+            np.testing.assert_allclose(
+                got[field], np.asarray(want[field], np.float32),
+                rtol=1e-5, atol=1e-5,
+                err_msg=f"{metric}/{field} n={n} v={v} bv={bv} miss={miss:.2f}",
+            )
