@@ -71,6 +71,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="host->device pipeline depth (blocks queued "
                    "while earlier transfers drain; minimum 1 — the "
                    "stream cannot run unbuffered)")
+    g.add_argument("--io-retries", type=int, default=3,
+                   help="transient-IO retries per incident (consecutive "
+                   "failures without a successfully read block) for "
+                   "file-backed sources: a failed block read re-opens "
+                   "the source and seeks back to the cursor (0 "
+                   "disables; corrupt blocks always fail fast)")
+    g.add_argument("--io-retry-backoff", type=float, default=0.05,
+                   help="initial retry backoff in seconds (exponential "
+                   "with jitter)")
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
@@ -147,6 +156,8 @@ def _job_from_args(args) -> JobConfig:
             ld_window=args.ld_window,
             ld_carry=args.ld_carry,
             prefetch_blocks=args.prefetch_blocks,
+            io_retries=args.io_retries,
+            io_retry_backoff_s=args.io_retry_backoff,
         ),
         compute=ComputeConfig(
             backend=args.backend,

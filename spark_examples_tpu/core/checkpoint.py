@@ -25,6 +25,20 @@ production job wants to discover it did implicitly).
 Writes are atomic (tmp dir + rename; multi-host writers barrier before
 process 0 rotates the directory) so a crash mid-write never corrupts the
 latest good checkpoint.
+
+Integrity: the manifest records a sha256 per data file (each process
+checksums its own tiles; process 0 merges per-process sidecars on the
+shared FS), and ``load()`` verifies every file before placing a single
+byte on a device. A truncated or bit-flipped file is a **checksum
+error**, not garbage silently added into a 40M-variant accumulation —
+and because rotation now RETAINS the previous checkpoint as ``.old``
+(one generation of history, costing one extra checkpoint of disk), a
+corrupt latest falls back to the previous good state instead of
+restarting the job from zero — and the fallback is promoted back into
+the latest slot on load (corrupt latest set aside as ``.corrupt``), so
+the next rotation never destroys the only good generation. Only when
+both generations fail verification does load raise
+:class:`CheckpointCorruptError`.
 """
 
 from __future__ import annotations
@@ -33,9 +47,44 @@ import hashlib
 import json
 import os
 import shutil
+import warnings
 
 import jax
 import numpy as np
+
+from spark_examples_tpu.core import faults
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Every on-disk generation failed checksum verification. Raised
+    (not silently ignored): restarting from zero discards work the
+    operator may be able to recover; delete the checkpoint directory to
+    restart deliberately."""
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class _TeeHashWriter:
+    """File wrapper hashing every byte as np.save writes it — the save
+    path must not re-read what it just wrote just to checksum it (that
+    would double every checkpoint's IO over a shared filesystem)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha256 = hashlib.sha256()
+
+    def write(self, data):
+        self.sha256.update(data)
+        return self._f.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
 
 
 def _sample_hash(sample_ids: list[str]) -> str:
@@ -57,11 +106,24 @@ def _tile_name(leaf: str, index) -> str:
     return f"{leaf}.t" + "_".join(str(o) for o in offs) + ".npy"
 
 
-def _barrier(name: str) -> None:
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+def _vote_all_ok(local_ok: bool, make_peer_error) -> None:
+    """THE abort protocol for every fallible cross-process step in this
+    module: allgather per-process ok flags (the gather doubles as the
+    synchronization point) and, when any process failed, raise
+    ``make_peer_error(bad_indices)`` on the processes whose local step
+    succeeded. Callers re-raise their own local exception afterwards.
+    Raising BESIDE a collective instead of voting through it would park
+    the surviving processes in it until the distributed timeout — the
+    hang class this layer exists to eliminate. Single-host: no-op."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+    oks = np.asarray(multihost_utils.process_allgather(
+        np.int32(bool(local_ok))
+    ))
+    if not oks.all() and local_ok:
+        raise make_peer_error([int(i) for i in np.flatnonzero(oks == 0)])
 
 
 def save(
@@ -98,30 +160,69 @@ def save(
     proc = jax.process_index() if jax.process_count() > 1 else 0
     is_primary = proc == 0
     tmp = path + ".tmp"
+    mkdir_error: Exception | None = None
     if is_primary:
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp, exist_ok=True)
-    _barrier("ckpt-mkdir")
-    os.makedirs(tmp, exist_ok=True)  # idempotent on the shared FS
+        try:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp, exist_ok=True)
+        except OSError as e:
+            mkdir_error = e
+    _vote_all_ok(mkdir_error is None, lambda bad: RuntimeError(
+        "checkpoint save: could not (re)create the tmp directory on "
+        "the primary process — see its log"
+    ))
+    if mkdir_error is not None:
+        raise mkdir_error
+
+    # filename -> sha256 of THIS process's writes; checksummed before the
+    # injection site fires so an injected truncation corrupts the file
+    # relative to its recorded digest (exactly what a real torn write
+    # looks like to load()).
+    checksums: dict[str, str] = {}
+
+    def _write(fname: str, host: np.ndarray) -> None:
+        fpath = os.path.join(tmp, fname)
+        with open(fpath, "wb") as f:
+            tee = _TeeHashWriter(f)
+            np.save(tee, host)
+        checksums[fname] = tee.sha256.hexdigest()
+        faults.fire("checkpoint.tile_write", path=fpath)
 
     layout: dict[str, str] = {}
-    for k, v in acc.items():
-        if _is_replicated(v):
-            layout[k] = "full"
-            if is_primary:
-                if isinstance(v, jax.Array) and not v.is_fully_addressable:
-                    host = np.asarray(v.addressable_data(0))
-                else:
-                    host = np.asarray(v)
-                np.save(os.path.join(tmp, f"{k}.npy"), host)
-        else:
-            layout[k] = "tiles"
-            for sh in v.addressable_shards:
-                np.save(
-                    os.path.join(tmp, _tile_name(k, sh.index)),
-                    np.asarray(sh.data),
-                )
+    write_error: Exception | None = None
+    try:
+        os.makedirs(tmp, exist_ok=True)  # idempotent on the shared FS
+        for k, v in acc.items():
+            if _is_replicated(v):
+                layout[k] = "full"
+                if is_primary:
+                    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                        host = np.asarray(v.addressable_data(0))
+                    else:
+                        host = np.asarray(v)
+                    _write(f"{k}.npy", host)
+            else:
+                layout[k] = "tiles"
+                for sh in v.addressable_shards:
+                    _write(_tile_name(k, sh.index), np.asarray(sh.data))
+        # Non-primary processes publish their tile checksums as sidecars
+        # on the shared FS; process 0 merges them into the manifest
+        # after the synchronization below (gathering variable-length
+        # dicts through the control plane would be needless ceremony
+        # when a shared FS is already required).
+        if jax.process_count() > 1 and not is_primary:
+            with open(os.path.join(tmp, f"checksums.{proc}.json"), "w") as f:
+                json.dump(checksums, f)
+    except Exception as e:
+        write_error = e
+    _vote_all_ok(write_error is None, lambda bad: RuntimeError(
+        f"checkpoint save: tile/sidecar write failed on process(es) "
+        f"{bad} (see their logs); the previous checkpoint generations "
+        "are untouched"
+    ))
+    if write_error is not None:
+        raise write_error
 
     # Per-process cursors: each process resumes its own partition.
     cursors = {str(proc): int(next_variant)}
@@ -146,23 +247,54 @@ def save(
         "process_count": jax.process_count(),
         "stream_stats": dict(stream_stats or {}),
     }
-    _barrier("ckpt-tiles-written")
+    primary_error: Exception | None = None
     if is_primary:
-        with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
-        # Never a window with zero good checkpoints: move the old one
-        # aside, land the new one, then delete the old. A crash
-        # mid-sequence leaves either `path` or `path.old` intact
-        # (load() checks both).
-        old = path + ".old"
-        if os.path.exists(old):
-            shutil.rmtree(old)
-        if os.path.exists(path):
-            os.replace(path, old)
-        os.replace(tmp, path)
-        if os.path.exists(old):
-            shutil.rmtree(old)
-    _barrier("ckpt-rotated")
+        try:
+            # Every non-primary process wrote exactly one sidecar before
+            # the barrier, so enumerate them BY PROCESS INDEX and fail
+            # loudly on a missing one — discovering them via listdir()
+            # would let a stale NFS directory cache silently drop a
+            # process's checksums from the manifest, quietly disabling
+            # verification for exactly those tiles.
+            for peer in range(1, jax.process_count()):
+                fpath = os.path.join(tmp, f"checksums.{peer}.json")
+                try:
+                    with open(fpath) as f:
+                        checksums.update(json.load(f))
+                except OSError as e:
+                    raise RuntimeError(
+                        f"checkpoint save: checksum sidecar from process "
+                        f"{peer} is missing/unreadable after the write "
+                        f"barrier ({e}) — the checkpoint directory is not "
+                        "consistently visible across processes (multi-host "
+                        "--checkpoint-dir must be a shared filesystem)"
+                    )
+                os.remove(fpath)
+            manifest["sha256"] = checksums
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f)
+            # Never a window with zero good checkpoints: move the old one
+            # aside, land the new one, and KEEP the old as `.old` — one
+            # generation of history (one extra checkpoint of disk), so a
+            # latest checkpoint that later fails checksum verification
+            # falls back to the previous good state instead of restarting
+            # the job from zero. A crash mid-sequence still leaves either
+            # `path` or `path.old` intact (load() checks both).
+            old = path + ".old"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            if os.path.exists(path):
+                os.replace(path, old)
+            os.replace(tmp, path)
+        except Exception as e:
+            primary_error = e
+    _vote_all_ok(primary_error is None, lambda bad: RuntimeError(
+        "checkpoint save: sidecar merge or rotation failed on the "
+        "primary process (see its log for the cause); the checkpoint "
+        "directory was left on the previous good generation"
+    ))
+    if primary_error is not None:
+        raise primary_error
 
 
 def _load_leaf(path: str, k: str, layout: str, manifest: dict, plan):
@@ -192,9 +324,242 @@ def _load_leaf(path: str, k: str, layout: str, manifest: dict, plan):
     return jax.make_array_from_callback((n, n), sharding, cb)
 
 
+def _local_files(manifest: dict, plan, sums: dict) -> list[str]:
+    """The subset of checkpoint files THIS process will load: replicated
+    leaves plus its own tiles. Verifying peers' tiles too would multiply
+    shared-FS read traffic by process_count (~11.6 GB of tiles becomes
+    ~93 GB over NFS at 8 processes) for no safety: each process only
+    ever places its own shards, and the agreement round already turns
+    any process's local verification failure into a global abort."""
+    layout = manifest.get("layout") or {}
+    if (plan is None or jax.process_count() == 1
+            or not any(v == "tiles" for v in layout.values())):
+        return sorted(sums)
+    n = manifest["n_samples"]
+    idx_map = plan.acc_sharding.devices_indices_map((n, n))
+    addressable = plan.acc_sharding.addressable_devices
+    mine: set[str] = set()
+    for k, lay in layout.items():
+        if lay == "tiles":
+            mine.update(_tile_name(k, idx_map[d]) for d in addressable)
+        else:
+            mine.add(f"{k}.npy")
+    return sorted(f for f in sums if f in mine)
+
+
+def _verify_files(path: str, manifest: dict, plan=None) -> str | None:
+    """Re-hash this process's data files against the manifest; a reason
+    string on the first mismatch/unreadable file, None when all verify.
+    Manifests without a ``sha256`` map (pre-integrity checkpoints)
+    verify vacuously — rejecting them would orphan every existing
+    checkpoint.
+
+    Deliberate tradeoff: a resume reads each local file twice (hash
+    here, np.load in _load_leaf). Folding the two into one pass would
+    mean either buffering every local tile in host RAM (breaking the
+    O(tile) host-peak guarantee the tiled layout exists for) or
+    verifying after placement (feeding unverified bytes to devices and
+    aborting mid-load). Resume is the rare path; save — which runs
+    every K blocks — hashes in one pass via _TeeHashWriter."""
+    sums = manifest.get("sha256")
+    if not sums:
+        return None
+    for fname in _local_files(manifest, plan, sums):
+        fpath = os.path.join(path, fname)
+        try:
+            faults.fire("checkpoint.tile_read", path=fpath)
+            got = _sha256_file(fpath)
+        except OSError as e:
+            return f"{fname}: unreadable ({e})"
+        if got != sums[fname]:
+            return f"{fname}: sha256 mismatch (truncated or corrupt)"
+    return None
+
+
+def _usable_generation(path: str, plan=None):
+    """First checkpoint generation (`path`, then `path.old`) whose
+    manifest parses and whose files verify -> (dir, manifest), None when
+    no generation exists at all, CheckpointCorruptError when generations
+    exist but every one fails verification."""
+    reasons: list[str] = []
+    for gen in (path, path + ".old"):
+        manifest_path = os.path.join(gen, "manifest.json")
+        if not os.path.exists(manifest_path):
+            continue
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            reasons.append(f"{gen}: manifest unreadable ({e})")
+            continue
+        reason = _verify_files(gen, manifest, plan)
+        if reason is not None:
+            reasons.append(f"{gen}: {reason}")
+            continue
+        if reasons:
+            warnings.warn(
+                f"checkpoint integrity: {'; '.join(reasons)} — falling "
+                f"back to the previous good generation at {gen}",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+        return gen, manifest
+    if reasons:
+        raise CheckpointCorruptError(
+            "no usable checkpoint generation: " + "; ".join(reasons)
+            + " — recover the files or delete the checkpoint "
+            "directory to deliberately restart from zero"
+        )
+    return None
+
+
+def _agree_generation(path: str, found, local_error=None, plan=None):
+    """Multi-host: every process must resume from the SAME generation.
+
+    Verification is per-process (a transient NFS read error or a stale
+    attribute cache can make one process reject the latest generation
+    while its peers accept it); without agreement each process would
+    load its own shards and cursor from a different generation and the
+    device-sharded accumulator would silently mix the two. One
+    allgather of the chosen generation index settles it: if any process
+    fell back, every process adopts the older generation (re-verifying
+    it locally); if some processes found no usable generation while
+    others did, the shared-filesystem contract is broken and every
+    process aborts together in this round.
+
+    ``local_error``: the CheckpointCorruptError this process's own
+    verification raised, if any. It MUST be voted through the gather
+    rather than raised before it — a process that raised pre-gather
+    while its peers entered the allgather would park those peers in the
+    collective until the distributed timeout, the exact hang this
+    layer's multihost watchdog exists to prevent."""
+    if jax.process_count() <= 1:
+        if local_error is not None:
+            raise local_error
+        return found
+    from jax.experimental import multihost_utils
+
+    # Ordered worst-to-best: latest=0, .old=1, nothing=2, corrupt=3.
+    NONE, CORRUPT = 2, 3
+    if local_error is not None:
+        mine = CORRUPT
+    else:
+        mine = NONE if found is None else (0 if found[0] == path else 1)
+    votes = np.asarray(multihost_utils.process_allgather(np.int32(mine)))
+    if (votes == CORRUPT).any():
+        if local_error is not None:
+            raise local_error
+        raise CheckpointCorruptError(
+            f"process(es) "
+            f"{[int(i) for i in np.flatnonzero(votes == CORRUPT)]} found "
+            f"every checkpoint generation at {path} corrupt — aborting "
+            "the resume on every process (recover the files or delete "
+            "the checkpoint directory to deliberately restart from zero)"
+        )
+    if (votes == NONE).any():
+        if (votes == NONE).all():
+            return found  # genuinely no checkpoint anywhere
+        raise CheckpointCorruptError(
+            f"process(es) {[int(i) for i in np.flatnonzero(votes == NONE)]} "
+            f"found no usable checkpoint generation at {path} while "
+            "others did — the checkpoint directory is not consistently "
+            "visible across processes (multi-host --checkpoint-dir must "
+            "be a filesystem shared by every process)"
+        )
+    agreed = int(votes.max())
+    result, reason = found, None
+    if agreed != mine:
+        # A peer fell back further than this process: adopt the agreed
+        # (older) generation so all processes resume from one state —
+        # re-verifying it locally, since this process never checked it
+        # (its own newer generation passed).
+        gen = path + ".old" if agreed else path
+        try:
+            with open(os.path.join(gen, "manifest.json")) as f:
+                manifest = json.load(f)
+            reason = _verify_files(gen, manifest, plan)
+        except (OSError, ValueError) as e:
+            reason = f"manifest unusable ({e})"
+        if reason is None:
+            result = gen, manifest
+    # Confirmation round: an adopter whose re-verification failed must
+    # not raise before its peers leave the agreement protocol — they
+    # would proceed into load()'s device placement and park in the next
+    # collective (everyone participates, adopters and non-adopters
+    # alike).
+    _vote_all_ok(reason is None, lambda bad: CheckpointCorruptError(
+        f"peers agreed on a checkpoint generation at {path}, but "
+        f"process(es) {bad} cannot use it"
+    ))
+    if reason is not None:
+        raise CheckpointCorruptError(
+            f"peers agreed on a checkpoint generation at {path}, but "
+            f"it is unusable on this process: {reason}"
+        )
+    if agreed != mine:
+        warnings.warn(
+            f"checkpoint generation agreement: adopting {result[0]} "
+            "because a peer process could not use a newer generation",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return result
+
+
+def _promote_fallback(path: str, found):
+    """When load resolved to ``.old`` (latest corrupt or missing),
+    promote the good generation back to ``path`` — the corrupt latest
+    is kept aside as ``path.corrupt`` for recovery. Without this, the
+    NEXT save's rotation would rmtree the only good generation and
+    demote the corrupt one into ``.old``: a crash in that window leaves
+    zero good checkpoints, and even without a crash the one-generation
+    fallback would be dead until the save after next."""
+    gen, manifest = found
+    if gen == path:
+        return found
+    proc = jax.process_index() if jax.process_count() > 1 else 0
+    err: Exception | None = None
+    if proc == 0:
+        try:
+            if os.path.exists(path):
+                corrupt = path + ".corrupt"
+                if os.path.exists(corrupt):
+                    shutil.rmtree(corrupt)
+                os.replace(path, corrupt)
+                warnings.warn(
+                    f"checkpoint: corrupt latest generation set aside "
+                    f"as {corrupt}; delete it once recovered",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+            os.replace(gen, path)
+        except OSError as e:
+            err = e
+    # Peers must not read the generation while process 0 renames it;
+    # the vote's gather is the barrier, and it carries the promotion
+    # outcome so a process-0 failure aborts every process in the same
+    # round instead of parking peers on files that moved.
+    _vote_all_ok(err is None, lambda bad: CheckpointCorruptError(
+        f"promotion of fallback checkpoint generation {gen} failed on "
+        "process 0 — see its log"
+    ))
+    if err is not None:
+        raise CheckpointCorruptError(
+            f"cannot promote fallback checkpoint generation {gen} back "
+            f"to {path}: {err}"
+        )
+    return path, manifest
+
+
 def load(path: str, metric: str, sample_ids: list[str],
          block_variants: int | None = None, plan=None):
     """Load (acc, next_variant, stream_stats) or None when absent.
+
+    Every file is checksum-verified BEFORE any leaf is placed on a
+    device; a truncated/corrupt generation falls back to ``.old`` (with
+    a warning), and when every generation is corrupt the load raises
+    :class:`CheckpointCorruptError` instead of feeding garbage into the
+    accumulation.
 
     Incompatible checkpoints (different metric, cohort, block grid,
     tile grid, or process count) are rejected rather than silently mixed
@@ -203,17 +568,17 @@ def load(path: str, metric: str, sample_ids: list[str],
     skip variants; a resume under a different mesh/mode would need a
     re-tiling no interrupted job should do implicitly.
     """
-    manifest_path = os.path.join(path, "manifest.json")
-    if not os.path.exists(manifest_path):
-        # Crash window fallback: the previous good checkpoint was moved
-        # aside but the new one never landed.
-        old = path + ".old"
-        if os.path.exists(os.path.join(old, "manifest.json")):
-            path, manifest_path = old, os.path.join(old, "manifest.json")
-        else:
-            return None
-    with open(manifest_path) as f:
-        manifest = json.load(f)
+    try:
+        mine, local_error = _usable_generation(path, plan), None
+    except CheckpointCorruptError as e:
+        # Don't raise yet in multi-host: peers may already be in the
+        # agreement allgather — vote the corruption instead so every
+        # process aborts together (_agree_generation re-raises it).
+        mine, local_error = None, e
+    found = _agree_generation(path, mine, local_error, plan)
+    if found is None:
+        return None
+    path, manifest = _promote_fallback(path, found)
     if block_variants is not None and manifest["block_variants"] != block_variants:
         raise ValueError(
             f"checkpoint at {path} was written with --block-variants "

@@ -77,6 +77,17 @@ class IngestConfig:
     # the chip fed on slow links; faster ingest (NVMe/DCN) can raise it
     # to deepen transfer/compute overlap at the cost of host RAM.
     prefetch_blocks: int = 2
+    # Transient-IO resilience for file-backed sources (ingest/
+    # resilient.py): on an IOError mid-stream the source is re-opened
+    # and sought back to the last yielded block's cursor, up to
+    # io_retries times per INCIDENT (the budget resets after every
+    # successfully read block, so independent hiccups across a long
+    # stream never accumulate into a kill) with exponential backoff +
+    # jitter from io_retry_backoff_s. 0 disables the wrapper (a
+    # transient NFS hiccup then kills the job). Corrupt blocks are
+    # NEVER retried — they fail fast with the resume cursor named.
+    io_retries: int = 3
+    io_retry_backoff_s: float = 0.05
     # Variant QC thresholds, applied as a stream transform over any
     # source (ingest/filters.py): drop variants with minor-allele
     # frequency < maf or missing-call rate > max_missing. Defaults are

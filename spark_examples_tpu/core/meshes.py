@@ -33,6 +33,24 @@ AXIS_I = "i"  # sample-row axis of the N x N accumulator
 AXIS_J = "j"  # sample-column axis of the N x N accumulator
 
 
+def shard_map(body, *, mesh: Mesh, in_specs, out_specs,
+              check_vma: bool = True):
+    """``jax.shard_map`` across JAX versions: the public entry point
+    (with its ``check_vma`` kwarg) when the installed JAX has one, else
+    the 0.4.x ``jax.experimental.shard_map`` fallback, whose equivalent
+    kwarg is the pre-rename ``check_rep``. Every shard_map in the
+    package routes through here so a JAX upgrade/downgrade is a one-line
+    compat problem, not a scattered one."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(body, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(body, mesh=mesh, in_specs=in_specs,
+               out_specs=out_specs, check_rep=check_vma)
+
+
 _distributed_initialized = False
 
 
@@ -55,6 +73,18 @@ def maybe_init_distributed() -> None:
     if _distributed_initialized:
         return
     if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        # Multi-process on the host (CPU) platform needs a cross-process
+        # collectives backend: without one, the first process-spanning
+        # jit dies with "Multiprocess computations aren't implemented on
+        # the CPU backend". Select gloo when this jaxlib carries the
+        # knob (real TPU meshes ignore it — it only shapes CPU client
+        # creation), and tolerate its absence: JAX versions that dropped
+        # the option wire CPU collectives through the distributed
+        # client on their own.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass
         kw = {}
         if os.environ.get("JAX_NUM_PROCESSES"):
             kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])

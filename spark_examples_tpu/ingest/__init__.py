@@ -28,5 +28,11 @@ from spark_examples_tpu.ingest.parquet import (  # noqa: F401
     ParquetSource,
     write_parquet,
 )
+from spark_examples_tpu.ingest.resilient import (  # noqa: F401
+    CorruptBlockError,
+    IngestExhaustedError,
+    RetryingSource,
+    RetryPolicy,
+)
 from spark_examples_tpu.ingest.synthetic import SyntheticSource  # noqa: F401
 from spark_examples_tpu.ingest.vcf import VcfSource, write_vcf  # noqa: F401

@@ -51,9 +51,29 @@ def _pyarrow():
     return pq
 
 
-def _column_np(table, name: str, dtype=None) -> np.ndarray:
-    """A (possibly chunked) table column as one numpy array."""
-    chunks = table.column(name).chunks
+def _column_np(table, name: str, dtype=None, null_fill=None) -> np.ndarray:
+    """A (possibly chunked) table column as one numpy array.
+
+    Arrow NULLs do NOT survive ``np.asarray`` on integer columns — the
+    cast backfills them with arbitrary values (observed: 0), which for a
+    dosage column silently recodes every uncalled genotype as
+    homozygous-reference. So nulls are handled explicitly: filled with
+    ``null_fill`` when given (sample columns pass -1, the documented
+    missing code), otherwise a hard error naming the column (metadata
+    columns, where a null has no meaningful encoding).
+    """
+    col = table.column(name)
+    if col.null_count:
+        if null_fill is None:
+            raise ValueError(
+                f"column {name!r} has {col.null_count} NULL value(s); "
+                "NULLs cannot be cast losslessly — re-export the table "
+                "without nulls in this column"
+            )
+        import pyarrow.compute as pc
+
+        col = pc.fill_null(col, null_fill)
+    chunks = col.chunks
     arrs = [np.asarray(c) if dtype is None else np.asarray(c, dtype)
             for c in chunks]
     return arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
@@ -96,7 +116,11 @@ class ParquetSource:
         unfiltered AND single-contig — multi-contig tables flush
         partial blocks at contig changes. Single-contig is decided from
         row-group column statistics alone (no data read); inconclusive
-        statistics decline conservatively."""
+        statistics decline conservatively. Min/max statistics IGNORE
+        nulls, so a contig column containing any NULL (which ``_pieces``
+        treats as its own contig=None run, with boundary flushes) must
+        also decline — the statistics must prove ``null_count == 0``
+        before one (min == max) value means one contig."""
         if self.references:
             return False
         if self._single_contig is None:
@@ -108,9 +132,11 @@ class ParquetSource:
                 seen: set = set()
                 ok = True
                 for rg in range(md.num_row_groups):
-                    st = self._rg_stats(md.row_group(rg), "contig")
-                    if st is None:
-                        ok = False
+                    rg_meta = md.row_group(rg)
+                    st = self._rg_stats(rg_meta, "contig")
+                    nulls = self._rg_null_count(rg_meta, "contig")
+                    if st is None or nulls != 0:
+                        ok = False  # inconclusive or null-bearing
                         break
                     seen.update((st[0], st[1]))
                 self._single_contig = ok and len(seen) == 1
@@ -142,6 +168,20 @@ class ParquetSource:
                 if st is None or not st.has_min_max:
                     return None
                 return st.min, st.max
+        return None
+
+    @staticmethod
+    def _rg_null_count(rg_meta, name: str):
+        """Recorded null count of one column in one row group, or None
+        when the writer recorded no statistics (conservatively
+        inconclusive — NOT zero)."""
+        for i in range(rg_meta.num_columns):
+            col = rg_meta.column(i)
+            if col.path_in_schema == name:
+                st = col.statistics
+                if st is None or not st.has_null_count:
+                    return None
+                return int(st.null_count)
         return None
 
     def _rg_may_overlap(self, rg_meta, names) -> bool:
@@ -214,8 +254,15 @@ class ParquetSource:
                 mask = None
             data = f.read_row_group(rg, columns=samples)
             # (v_rows, N) → (N, v): one astype per sample column, then a
-            # stack — columnar decode, no per-record Python loop.
-            cols = np.stack([_column_np(data, s, np.int8) for s in samples])
+            # stack — columnar decode, no per-record Python loop. NULL
+            # dosages (routine in BigQuery exports for uncalled
+            # genotypes) become -1, the documented missing code — NOT
+            # the silent NULL->0 (homozygous-reference) an unchecked
+            # arrow->numpy cast produces.
+            cols = np.stack(
+                [_column_np(data, s, np.int8, null_fill=-1)
+                 for s in samples]
+            )
             pos = (
                 _column_np(meta_tbl, "position", np.int64)
                 if has_pos else None
@@ -232,15 +279,20 @@ class ParquetSource:
                 yield cols, pos, None
                 continue
             # Split the group at contig changes so no piece spans one.
+            # NULL contigs (None entries from to_pylist) form their own
+            # contig=None runs — boundaries against named contigs still
+            # flush, and the label is a real None, not the str(None)
+            # "None" pseudo-contig an unchecked str() would mint.
             edges = np.flatnonzero(contigs[1:] != contigs[:-1]) + 1
             for lo, hi in zip(
                 np.concatenate(([0], edges)),
                 np.concatenate((edges, [len(contigs)])),
             ):
+                label = contigs[lo]
                 yield (
                     cols[:, lo:hi],
                     pos[lo:hi] if pos is not None else None,
-                    str(contigs[lo]),
+                    None if label is None else str(label),
                 )
 
     def blocks(self, block_variants: int, start_variant: int = 0):

@@ -20,6 +20,7 @@ from typing import Iterator
 import jax
 import numpy as np
 
+from spark_examples_tpu.core import faults
 from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE, MISSING
 from spark_examples_tpu.ingest import bitpack
 from spark_examples_tpu.ingest.source import BlockMeta, GenotypeSource
@@ -125,6 +126,11 @@ def stream_to_device(
         source, block_variants, start_variant, prefetch, pad_multiple,
         pack, stats,
     ):
+        # Chaos site: a "delay" here is a stalled host->device link (the
+        # prefetch queue must absorb it); an "io_error" is a failed
+        # transfer (not retryable — the stream's cursor semantics make
+        # the job resumable from its checkpoint instead).
+        faults.fire("device.put")
         if sharding is not None:
             dev_block = jax.device_put(host_block, sharding)
         elif device is not None:

@@ -1,0 +1,229 @@
+"""Retrying ingest — transient IO failures must not kill a 40M-variant job.
+
+The reference got this for free: a failed Spark task re-read its RDD
+partition through lineage (SURVEY.md §5 "Failure detection"). The
+TPU-native stream has no lineage, so the equivalent is explicit:
+:class:`RetryingSource` wraps any file-backed
+:class:`~spark_examples_tpu.ingest.source.GenotypeSource` and, when a
+block read raises a transient IO error, **re-opens the source and seeks
+back to the cursor** — every source's ``blocks(bv, start)`` already
+implements deterministic resume for checkpointing (SURVEY.md §5), and
+retry rides exactly that contract: the re-opened iterator restarts at
+the last successfully yielded block's ``meta.stop``, so the downstream
+stream is byte-identical to an unfailed read.
+
+What is deliberately NOT retried:
+
+- **Corrupt blocks fail fast.** A block with the wrong sample count,
+  rank, or dtype means the file (or a transform above it) is damaged,
+  not flaky — retrying would re-yield the same garbage into the
+  accumulation. The error names the resume cursor so an operator can
+  fix the input and resume from a checkpoint instead of restarting.
+  A "skip the bad block" policy is intentionally not offered: silently
+  dropping variants shifts every later cursor and corrupts
+  checkpoint/resume alignment.
+- **Non-IO exceptions.** ValueError/contract violations propagate
+  unchanged (they are bugs or bad configs, not weather).
+
+Fault-injection site ``ingest.block_read`` (core/faults.py) fires
+*inside* the retry boundary, so the chaos tests exercise precisely the
+path a flaky NFS mount would.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+import warnings
+from dataclasses import dataclass
+
+import numpy as np
+
+from spark_examples_tpu.core import faults
+from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE
+from spark_examples_tpu.ingest.source import GenotypeSource
+
+
+class IngestExhaustedError(IOError):
+    """Bounded retries ran out. Carries the resume cursor in the message
+    (and as ``.cursor``) so the job can be restarted from a checkpoint
+    or an explicit ``start_variant`` without re-reading good data."""
+
+    def __init__(self, msg: str, cursor: int):
+        super().__init__(msg)
+        self.cursor = cursor
+
+
+class CorruptBlockError(ValueError):
+    """A block that cannot be valid (wrong cohort width / rank / dtype).
+    Never retried — fail fast with the cursor named."""
+
+    def __init__(self, msg: str, cursor: int):
+        super().__init__(msg)
+        self.cursor = cursor
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with jitter (decorrelated restarts
+    when many hosts share one flaky filesystem)."""
+
+    max_retries: int = 3  # per-incident: consecutive failures without progress
+    backoff_s: float = 0.05
+    backoff_multiplier: float = 2.0
+    max_backoff_s: float = 5.0
+    jitter: float = 0.25  # +- fraction of the computed backoff
+    retry_on: tuple = (IOError, OSError)
+
+    def sleep_s(self, attempt: int, rng: random.Random) -> float:
+        base = min(
+            self.backoff_s * self.backoff_multiplier ** attempt,
+            self.max_backoff_s,
+        )
+        return base * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+
+
+@dataclass
+class RetryingSource:
+    """Transparent retry wrapper over a file-backed source.
+
+    Metadata properties and the ``exact_n_variants`` claim pass through
+    (a retried stream yields the identical block sequence, so the inner
+    source's contracts survive wrapping). The packed transport
+    (``packed_blocks``) is forwarded under the same retry loop when the
+    inner source has one.
+
+    ``reopen``: factory returning a FRESH inner source, invoked before
+    each retry. Sources that open file handles inside ``blocks()``
+    (VCF/plink/parquet) re-open naturally and don't need it; memmap-
+    backed sources (the packed store) hold their mapping on the object,
+    so without a rebuilder every "retry" would re-slice the same stale
+    mapping and the budget would exhaust without one real re-open.
+    (A fatal mmap fault the kernel reports as SIGBUS is outside any
+    userspace retry's reach — this covers errors surfaced as OSError.)
+    The retry budget is per-incident: a successfully yielded block
+    resets it, so independent recoverable hiccups hours apart never
+    accumulate into a job kill.
+    """
+
+    inner: GenotypeSource
+    policy: RetryPolicy = RetryPolicy()
+    seed: int = 0
+    reopen: object = None  # () -> GenotypeSource, or None
+
+    def __post_init__(self):
+        if hasattr(self.inner, "packed_blocks"):
+            self.packed_blocks = self._packed_blocks
+
+    @property
+    def n_samples(self) -> int:
+        return self.inner.n_samples
+
+    @property
+    def n_variants(self) -> int:
+        return self.inner.n_variants
+
+    @property
+    def sample_ids(self) -> list[str]:
+        return self.inner.sample_ids
+
+    @property
+    def exact_n_variants(self) -> bool:
+        return bool(getattr(self.inner, "exact_n_variants", False))
+
+    def _validate(self, block: np.ndarray, cursor: int) -> None:
+        n = self.inner.n_samples
+        if (
+            getattr(block, "ndim", 0) != 2
+            or block.shape[0] != n
+            or block.dtype != GENOTYPE_DTYPE
+        ):
+            raise CorruptBlockError(
+                f"corrupt block at variant cursor {cursor}: got "
+                f"shape {getattr(block, 'shape', None)} dtype "
+                f"{getattr(block, 'dtype', None)}, expected ({n}, v) "
+                f"{np.dtype(GENOTYPE_DTYPE).name} — the input is damaged "
+                "(not a transient failure, so it is not retried); fix the "
+                f"file and resume from start_variant={cursor} (or the "
+                "last --checkpoint-dir checkpoint)",
+                cursor,
+            )
+
+    def _stream(self, opener, block_variants: int, start_variant: int,
+                validate):
+        """The shared retry loop: ``opener(cursor)`` re-opens the inner
+        iterator at a cursor; blocks re-index over the OUTPUT stream so
+        downstream ordinals don't jump backwards across a re-open."""
+        cursor = start_variant
+        idx = 0
+        rng = random.Random(self.seed)
+        retries_left = self.policy.max_retries
+        need_reopen = False
+        while True:
+            it = None
+            try:
+                # The rebuild and the open live INSIDE the boundary: on
+                # a still-flaky mount reopen()/opener() fail exactly
+                # like a block read, and must consume the same budget
+                # and produce the same cursor-naming exhaustion error —
+                # not escape as a raw OSError.
+                if need_reopen and self.reopen is not None:
+                    self.inner = self.reopen()
+                need_reopen = False
+                it = opener(cursor)
+                for block, meta in it:
+                    faults.fire("ingest.block_read")
+                    if validate:
+                        self._validate(block, meta.start)
+                    yield block, dataclasses.replace(meta, index=idx)
+                    idx += 1
+                    cursor = meta.stop
+                    # Progress restores the budget: the bound is on
+                    # CONSECUTIVE failures (one incident), not on the
+                    # lifetime of a stream — otherwise job-death
+                    # probability would grow with stream length and a
+                    # 40M-variant run would die on its 4th independent,
+                    # individually-recoverable hiccup.
+                    retries_left = self.policy.max_retries
+                return
+            except self.policy.retry_on as e:
+                if retries_left <= 0:
+                    raise IngestExhaustedError(
+                        f"ingest failed at variant cursor {cursor} after "
+                        f"{self.policy.max_retries} retries: {e!r} — "
+                        "resume from the last --checkpoint-dir checkpoint "
+                        f"or restart this stream at start_variant={cursor}",
+                        cursor,
+                    ) from e
+                attempt = self.policy.max_retries - retries_left
+                retries_left -= 1
+                delay = self.policy.sleep_s(attempt, rng)
+                warnings.warn(
+                    f"transient ingest error at variant cursor {cursor} "
+                    f"({e!r}); retrying in {delay * 1e3:.0f} ms "
+                    f"({retries_left} retries left)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                time.sleep(delay)
+                need_reopen = True
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        yield from self._stream(
+            lambda cur: self.inner.blocks(block_variants, cur),
+            block_variants, start_variant, validate=True,
+        )
+
+    def _packed_blocks(self, block_variants: int, start_variant: int = 0):
+        # Packed blocks are (N, width/4) uint8 — shape/dtype validation
+        # lives in the dense contract, not here; the codec's unpack is
+        # bounds-safe by construction.
+        yield from self._stream(
+            lambda cur: self.inner.packed_blocks(block_variants, cur),
+            block_variants, start_variant, validate=False,
+        )

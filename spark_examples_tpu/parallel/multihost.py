@@ -25,8 +25,12 @@ The TPU-native successor, completing the DCN story SURVEY §5 names
   that know their length a priori (``exact_n_variants`` — synthetic,
   memmapped packed/array stores, the WindowSource partitions
   ``build_source`` makes from them) agree on the global step count in
-  ONE upfront allgather and then stream with zero further control
-  traffic; unknown-length sources (VCF ranges, filtered streams) fall
+  ONE upfront allgather, stream with zero mid-stream control traffic,
+  and close with ONE terminal agreement round (every process allgathers
+  an ok flag, so a broken exact_n_variants claim aborts every process
+  within one consensus period instead of hanging peers until a
+  distributed timeout); unknown-length sources (VCF ranges, filtered
+  streams) fall
   back to one "anyone still has data?" consensus per
   ``consensus_every`` blocks, padding stragglers within each group.
 
@@ -42,6 +46,7 @@ import numpy as np
 import jax
 from jax.experimental import multihost_utils
 
+from spark_examples_tpu.core import faults
 from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE, MISSING
 from spark_examples_tpu.ingest.prefetch import (
     PACKED_MISSING,
@@ -148,8 +153,9 @@ def stream_global_blocks(
     steps where this process had no data left (its slab was all-MISSING
     padding).
 
-    Control-plane cost: ONE upfront step-count allgather when every
-    process's source knows its length (``exact_n_variants``), else one
+    Control-plane cost: ONE upfront step-count allgather plus ONE
+    terminal contract-agreement round when every process's source knows
+    its length (``exact_n_variants``), else one
     has-data consensus per ``consensus_every`` blocks (stragglers pad
     out each group; worst case wastes ``consensus_every - 1``
     all-padding steps at the tail — semantically zero, each costing one
@@ -178,6 +184,9 @@ def stream_global_blocks(
     def gather_round(value) -> np.ndarray:
         if stats is not None:
             stats["consensus_rounds"] = stats.get("consensus_rounds", 0) + 1
+        # Chaos site: a "delay" fault here is a straggling control plane
+        # — the collective must absorb it, not deadlock or reorder.
+        faults.fire("multihost.consensus")
         return allgather(value)
 
     def assemble(item):
@@ -205,13 +214,24 @@ def stream_global_blocks(
                 item = next(it, None)
                 produced += item is not None
                 yield assemble(item)
-            if produced != local_steps or next(it, None) is not None:
-                raise AssertionError(
-                    f"source produced {'more' if produced == local_steps else produced} "
-                    f"blocks against its claimed {local_steps} — its "
-                    "exact_n_variants contract is broken (fix the "
-                    "source; trusting the claim would silently corrupt "
-                    "the global accumulation)"
+            # Contract watchdog: every process joins ONE final agreement
+            # round on its own ok flag, so a broken exact_n_variants
+            # claim aborts ALL processes within this consensus period —
+            # a process-local raise would leave peers parked inside the
+            # next collective until a distributed timeout (they cannot
+            # learn the stream ended early any other way).
+            ok = produced == local_steps and next(it, None) is None
+            oks = gather_round(np.int32(ok))
+            if not oks.all():
+                bad = [int(i) for i in np.flatnonzero(oks == 0)]
+                raise RuntimeError(
+                    f"process(es) {bad} streamed a different block count "
+                    "than their claimed exact_n_variants (this process: "
+                    f"{'ok' if ok else f'{produced} blocks against claimed {local_steps}'}) "
+                    "— the source's contract is broken; fix the source "
+                    "(trusting the claim would silently corrupt the "
+                    "global accumulation). All processes abort together "
+                    "in this agreement round."
                 )
             return
         # Unknown-length fallback (some process reported -1): one

@@ -144,11 +144,16 @@ def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
     """Shared output tail of every PCoA route: solver-matched FLOP
     credit, result assembly, optional TSV persistence. ``eigh_iters``
     must match the randomized solver's actual iteration count (the
-    sharded PCA route runs more than the default)."""
+    sharded PCA route runs more than the default); the oversample is
+    always the job's own knob — every randomized call site passes
+    ``job.compute.eigh_oversample`` to its solver."""
     # FLOP credit must match the solver actually run (the randomized
-    # path's whole point is doing far fewer FLOPs than dense ~9n^3).
+    # path's whole point is doing far fewer FLOPs than dense ~9n^3) —
+    # including the probe width k + oversample, which scales every
+    # B @ Q product (ADVICE r5 finding 3).
     timer.add("eigh_flops", eigh_flops(len(sample_ids), method=method,
                                        k=job.compute.num_pc,
+                                       oversample=job.compute.eigh_oversample,
                                        iters=eigh_iters))
     out = CoordsOutput(
         sample_ids, fetch_replicated(coords), fetch_replicated(vals), timer,

@@ -231,7 +231,7 @@ def _cross_update_tiled(plan: CrossPlan, stats: tuple[str, ...]):
         upd = genotype.cross_stats(bn, br, stats)
         return {k: acc[k] + upd[k] for k in stats}
 
-    fn = jax.shard_map(
+    fn = meshes.shard_map(
         body, mesh=plan.mesh,
         in_specs=(acc_specs, P(meshes.AXIS_I, None),
                   P(meshes.AXIS_J, None)),

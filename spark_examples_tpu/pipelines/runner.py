@@ -155,6 +155,33 @@ def _build_local_partition(cfg: IngestConfig):
     return WindowSource(src, start, stop)
 
 
+def _maybe_retrying(src, cfg: IngestConfig, reopen=None):
+    """Wrap a file-backed source in the transient-IO retry boundary
+    (ingest/resilient.py): a flaky read re-opens and seeks back to the
+    cursor instead of killing a 40M-variant job. Synthetic sources do
+    no IO and stay unwrapped; io_retries=0 disables.
+
+    ``reopen`` (a fresh-source factory) is required for sources whose
+    file state lives on the object (the packed store's memmap) — without
+    it a retry would re-slice the same dead mapping; handle-per-blocks()
+    sources (VCF/plink/parquet) re-open naturally."""
+    if cfg.io_retries <= 0:
+        return src
+    from spark_examples_tpu.ingest.resilient import RetryingSource, RetryPolicy
+
+    return RetryingSource(
+        src,
+        policy=RetryPolicy(max_retries=cfg.io_retries,
+                           backoff_s=cfg.io_retry_backoff_s),
+        # Mix the process index into the jitter seed: hosts sharing one
+        # flaky filesystem must NOT retry in lockstep (identical seeds
+        # would synchronize every backoff and re-trigger the overload
+        # the jitter exists to spread out).
+        seed=cfg.seed + jax.process_index(),
+        reopen=reopen,
+    )
+
+
 def _build_raw_source(cfg: IngestConfig):
     if cfg.source == "synthetic":
         return SyntheticSource(
@@ -166,24 +193,25 @@ def _build_raw_source(cfg: IngestConfig):
     if cfg.source == "vcf":
         if not cfg.path:
             raise ValueError("vcf source requires ingest.path")
-        return _maybe_partitioned(VcfSource, cfg)
+        return _maybe_retrying(_maybe_partitioned(VcfSource, cfg), cfg)
     if cfg.source == "packed":
         if not cfg.path:
             raise ValueError("packed source requires ingest.path")
-        return load_packed(cfg.path)
+        return _maybe_retrying(load_packed(cfg.path), cfg,
+                               reopen=lambda: load_packed(cfg.path))
     if cfg.source == "plink":
         if not cfg.path:
             raise ValueError(
                 "plink source requires ingest.path (fileset prefix or "
                 ".bed path)"
             )
-        return _maybe_partitioned(PlinkSource, cfg)
+        return _maybe_retrying(_maybe_partitioned(PlinkSource, cfg), cfg)
     if cfg.source == "parquet":
         if not cfg.path:
             raise ValueError("parquet source requires ingest.path")
         from spark_examples_tpu.ingest.parquet import ParquetSource
 
-        return _maybe_partitioned(ParquetSource, cfg)
+        return _maybe_retrying(_maybe_partitioned(ParquetSource, cfg), cfg)
     raise ValueError(f"unknown source {cfg.source!r}")
 
 

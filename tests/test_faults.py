@@ -1,0 +1,568 @@
+"""Crash-recovery under deterministic fault injection (core/faults.py).
+
+Every recovery path the fault-tolerance layer claims is executed here
+under injected faults and held to the strongest available standard:
+bit-identical results against a clean run (the gram accumulators are
+integer counts — there is no tolerance to hide behind).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults
+from spark_examples_tpu.core.config import (
+    ComputeConfig,
+    IngestConfig,
+    JobConfig,
+)
+from spark_examples_tpu.ingest import ArraySource
+from spark_examples_tpu.ingest.resilient import (
+    CorruptBlockError,
+    IngestExhaustedError,
+    RetryingSource,
+    RetryPolicy,
+)
+from spark_examples_tpu.pipelines import runner
+from tests.conftest import random_genotypes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_RETRY = RetryPolicy(max_retries=4, backoff_s=0.001, max_backoff_s=0.01)
+
+
+# ---------------------------------------------------------------- faults core
+
+
+def test_spec_parse_roundtrip():
+    s = faults.FaultSpec.parse("ingest.block_read:io_error:p=0.5:after=3:max=2")
+    assert s.site == "ingest.block_read"
+    assert s.kind == "io_error"
+    assert (s.probability, s.after, s.max_fires) == (0.5, 3, 2)
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.FaultSpec.parse("nonsite:io_error")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultSpec.parse("device.put:explode")
+    with pytest.raises(ValueError, match="valid keys"):
+        faults.FaultSpec.parse("device.put:delay:frequency=2")
+
+
+def test_injector_after_and_max_are_deterministic():
+    with faults.armed(["device.put:io_error:after=2:max=2"]) as inj:
+        fired = []
+        for _ in range(6):
+            try:
+                faults.fire("device.put")
+                fired.append(False)
+            except faults.InjectedFault:
+                fired.append(True)
+        # hits 0,1 pass; 2,3 fire; exhausted afterwards.
+        assert fired == [False, False, True, True, False, False]
+        assert inj.fire_count("device.put") == 2
+    assert faults.fire_count("device.put") == 0  # disarmed
+
+
+def test_disarmed_fire_is_noop():
+    faults.disarm()
+    faults.fire("ingest.block_read")  # must not raise
+
+
+# ---------------------------------------------------------- retrying ingest
+
+
+def test_retry_transient_io_error_bit_exact(rng):
+    """Injected transient IOErrors at the block-read site are retried
+    (re-open + seek to cursor) and the full stream is bit-identical to
+    an uninjected read."""
+    g = random_genotypes(rng, 12, 700, missing_rate=0.1)
+    src = RetryingSource(ArraySource(g), policy=FAST_RETRY)
+    clean = [(b.copy(), m) for b, m in ArraySource(g).blocks(128)]
+    with faults.armed(["ingest.block_read:io_error:after=2:max=2"]) as inj:
+        with pytest.warns(RuntimeWarning, match="transient ingest error"):
+            got = [(b.copy(), m) for b, m in src.blocks(128)]
+        assert inj.fire_count("ingest.block_read") == 2
+    assert len(got) == len(clean)
+    for (gb, gm), (cb, cm) in zip(got, clean):
+        np.testing.assert_array_equal(gb, cb)
+        assert (gm.start, gm.stop, gm.index) == (cm.start, cm.stop, cm.index)
+
+
+def test_retry_exhaustion_names_cursor(rng):
+    g = random_genotypes(rng, 8, 512)
+    src = RetryingSource(
+        ArraySource(g), policy=RetryPolicy(max_retries=1, backoff_s=0.001)
+    )
+    # Unlimited fires outlast the 1-retry budget; 2 blocks (256 variants)
+    # stream before the first fault, so that boundary is the cursor.
+    with faults.armed(["ingest.block_read:io_error:after=2:max=0"]):
+        with pytest.raises(IngestExhaustedError, match="cursor 256") as ei:
+            with pytest.warns(RuntimeWarning):
+                list(src.blocks(128))
+    assert ei.value.cursor == 256
+
+
+def test_retry_budget_resets_on_progress(rng):
+    """The retry budget bounds CONSECUTIVE failures (one incident), not
+    the stream lifetime: independent recoverable hiccups far apart must
+    not accumulate into a job kill."""
+    g = random_genotypes(rng, 8, 1024)
+    src = RetryingSource(
+        ArraySource(g), policy=RetryPolicy(max_retries=1, backoff_s=0.001)
+    )
+    clean = [(b.copy(), m) for b, m in ArraySource(g).blocks(128)]
+    # Four separate single-failure incidents, each with >= 1 block of
+    # progress in between — more total failures than max_retries=1 would
+    # survive per-stream, recoverable per-incident.
+    specs = [f"ingest.block_read:io_error:after={a}:max=1"
+             for a in (1, 4, 7, 10)]
+    with faults.armed(specs) as inj:
+        with pytest.warns(RuntimeWarning, match="transient ingest error"):
+            got = [(b.copy(), m) for b, m in src.blocks(128)]
+        assert inj.fire_count("ingest.block_read") == 4
+    assert len(got) == len(clean)
+    for (gb, gm), (cb, cm) in zip(got, clean):
+        np.testing.assert_array_equal(gb, cb)
+        assert (gm.start, gm.stop, gm.index) == (cm.start, cm.stop, cm.index)
+
+
+def test_retry_reopen_rebuilds_inner_source(rng):
+    """``reopen`` swaps in a FRESH inner source before each retry — the
+    recovery path memmap-backed sources (packed store) need, where the
+    broken file state lives on the object itself."""
+    g = random_genotypes(rng, 8, 512)
+
+    class DeadMapping(ArraySource):
+        """Fails every read, like a memmap whose file went away."""
+
+        def blocks(self, block_variants, start_variant=0):
+            raise IOError("stale mapping")
+            yield  # pragma: no cover
+
+    rebuilt = []
+
+    def reopen():
+        rebuilt.append(True)
+        return ArraySource(g)
+
+    src = RetryingSource(DeadMapping(g), policy=FAST_RETRY, reopen=reopen)
+    clean = [(b.copy(), m) for b, m in ArraySource(g).blocks(128)]
+    with pytest.warns(RuntimeWarning, match="transient ingest error"):
+        got = [(b.copy(), m) for b, m in src.blocks(128)]
+    assert rebuilt  # the factory actually ran
+    assert len(got) == len(clean)
+    for (gb, gm), (cb, cm) in zip(got, clean):
+        np.testing.assert_array_equal(gb, cb)
+
+
+def test_build_source_packed_reopen_rebuilds_mapping(tmp_path, rng):
+    """build_source gives the packed store a reopen factory (its memmap
+    cannot recover by re-slicing itself)."""
+    from spark_examples_tpu.ingest.packed import save_packed
+
+    g = np.abs(random_genotypes(rng, 8, 256))
+    store = str(tmp_path / "store")
+    save_packed(store, g, bits=2)
+    src = runner.build_source(IngestConfig(source="packed", path=store))
+    assert src.reopen is not None
+    fresh = src.reopen()
+    assert fresh is not src.inner and hasattr(fresh, "packed_blocks")
+
+
+def test_corrupt_block_fails_fast_with_cursor(rng):
+    """A structurally invalid block is never retried: one attempt, an
+    actionable error naming the resume cursor."""
+    g = random_genotypes(rng, 10, 512)
+
+    class Corrupting(ArraySource):
+        def blocks(self, bv, start_variant=0):
+            for b, m in super().blocks(bv, start_variant):
+                if m.start == 256:  # third block: drop a sample row
+                    b = b[:-1]
+                yield b, m
+
+    src = RetryingSource(Corrupting(g), policy=FAST_RETRY)
+    with pytest.raises(CorruptBlockError, match="cursor 256") as ei:
+        list(src.blocks(128))
+    assert ei.value.cursor == 256
+    assert "start_variant=256" in str(ei.value)
+
+
+def test_retrying_source_in_similarity_job_bit_exact(rng):
+    """The job surface: a similarity run whose ingest suffers transient
+    IOErrors AND transfer stalls matches the clean run bit-identically
+    (ibs counts are integers — exactness is the only passing grade)."""
+    g = random_genotypes(rng, 16, 1024, missing_rate=0.1)
+    job = JobConfig(ingest=IngestConfig(block_variants=128),
+                    compute=ComputeConfig(metric="ibs"))
+    clean = runner.run_similarity(job, source=ArraySource(g))
+    chaotic_src = RetryingSource(ArraySource(g), policy=FAST_RETRY)
+    with faults.armed([
+        "ingest.block_read:io_error:after=3:max=2",
+        "device.put:delay:delay=0.01:max=3",
+        "multihost.consensus:delay:delay=0.01:max=2",  # inert single-host
+    ]) as inj:
+        with pytest.warns(RuntimeWarning, match="transient ingest error"):
+            chaotic = runner.run_similarity(job, source=chaotic_src)
+        assert inj.fire_count("ingest.block_read") == 2
+        assert inj.fire_count("device.put") == 3
+    np.testing.assert_array_equal(chaotic.similarity, clean.similarity)
+    np.testing.assert_array_equal(chaotic.distance, clean.distance)
+    assert chaotic.n_variants == clean.n_variants
+
+
+def test_build_source_wraps_file_sources(tmp_path, rng):
+    """build_source applies the retry boundary to file-backed sources
+    (and leaves synthetic unwrapped — it does no IO)."""
+    from spark_examples_tpu.ingest.packed import save_packed
+
+    g = random_genotypes(rng, 8, 256, missing_rate=0.0)
+    g = np.abs(g)  # packed store holds dosages
+    store = str(tmp_path / "store")
+    save_packed(store, g, bits=2)
+    src = runner.build_source(IngestConfig(source="packed", path=store))
+    assert isinstance(src, RetryingSource)
+    assert src.exact_n_variants  # inner claims pass through
+    assert hasattr(src, "packed_blocks")  # packed transport forwarded
+    nosrc = runner.build_source(
+        IngestConfig(source="packed", path=store, io_retries=0)
+    )
+    assert not isinstance(nosrc, RetryingSource)
+    syn = runner.build_source(IngestConfig(source="synthetic",
+                                           n_samples=8, n_variants=256))
+    assert not isinstance(syn, RetryingSource)
+
+
+def test_retrying_packed_transport_bit_exact(tmp_path, rng):
+    from spark_examples_tpu.ingest.packed import load_packed, save_packed
+
+    g = np.abs(random_genotypes(rng, 8, 512, missing_rate=0.1))
+    store = str(tmp_path / "store")
+    save_packed(store, g, bits=2)
+    clean = [(b.copy(), m) for b, m in load_packed(store).packed_blocks(128)]
+    src = RetryingSource(load_packed(store), policy=FAST_RETRY)
+    with faults.armed(["ingest.block_read:io_error:after=1:max=1"]):
+        with pytest.warns(RuntimeWarning):
+            got = [(b.copy(), m) for b, m in src.packed_blocks(128)]
+    assert len(got) == len(clean)
+    for (gb, _), (cb, _) in zip(got, clean):
+        np.testing.assert_array_equal(gb, cb)
+
+
+# ------------------------------------------------------ checkpoint integrity
+
+
+def _ckpt_job(ckpt_dir: str) -> JobConfig:
+    return JobConfig(
+        ingest=IngestConfig(block_variants=128),
+        compute=ComputeConfig(metric="ibs", checkpoint_dir=ckpt_dir,
+                              checkpoint_every_blocks=2),
+    )
+
+
+def _run_until(job, g, die_at_block: int):
+    """Stream with checkpointing and die (exception) at a given block."""
+
+    class Dying(ArraySource):
+        def blocks(self, bv, start_variant=0):
+            for i, (b, m) in enumerate(super().blocks(bv, start_variant)):
+                if m.start >= die_at_block * bv:
+                    raise RuntimeError("simulated preemption")
+                yield b, m
+
+    with pytest.raises(RuntimeError, match="preemption"):
+        runner.run_similarity(job, source=Dying(g))
+
+
+def test_checkpoint_manifest_records_sha256(tmp_path, rng):
+    g = random_genotypes(rng, 16, 1024)
+    ckpt = str(tmp_path / "ck")
+    _run_until(_ckpt_job(ckpt), g, die_at_block=4)
+    manifest = json.load(open(os.path.join(ckpt, "manifest.json")))
+    sums = manifest["sha256"]
+    data_files = [f for f in os.listdir(ckpt) if f.endswith(".npy")]
+    assert sorted(sums) == sorted(data_files)
+    from spark_examples_tpu.core.checkpoint import _sha256_file
+
+    for f, want in sums.items():
+        assert _sha256_file(os.path.join(ckpt, f)) == want
+
+
+def test_truncated_tile_falls_back_to_old_generation(tmp_path, rng):
+    """A checkpoint whose latest generation has a truncated tile is
+    rejected by checksum and the retained .old generation restores;
+    the resumed job still matches the clean run bit-exactly (it simply
+    re-streams from the older cursor)."""
+    g = random_genotypes(rng, 16, 1024, missing_rate=0.1)
+    ckpt = str(tmp_path / "ck")
+    job = _ckpt_job(ckpt)
+    # Two+ saves happen (8 blocks / every 2); truncate a file of the
+    # LATEST generation only.
+    _run_until(job, g, die_at_block=6)
+    assert os.path.isdir(ckpt) and os.path.isdir(ckpt + ".old")
+    victim = sorted(
+        f for f in os.listdir(ckpt) if f.endswith(".npy")
+    )[0]
+    with open(os.path.join(ckpt, victim), "r+b") as f:
+        f.truncate(8)
+    with pytest.warns(RuntimeWarning, match="sha256 mismatch"):
+        resumed = runner.run_similarity(job, source=ArraySource(g))
+    clean = runner.run_similarity(
+        JobConfig(ingest=IngestConfig(block_variants=128),
+                  compute=ComputeConfig(metric="ibs")),
+        source=ArraySource(g),
+    )
+    np.testing.assert_array_equal(resumed.similarity, clean.similarity)
+
+
+def test_fallback_promotes_old_generation(tmp_path, rng):
+    """Resuming from .old must promote it back to the latest slot (the
+    corrupt latest set aside as .corrupt) — otherwise the NEXT save's
+    rotation would rmtree the only good generation and demote the
+    corrupt one into .old, leaving a crash window with zero good
+    checkpoints."""
+    g = random_genotypes(rng, 16, 1024, missing_rate=0.1)
+    ckpt = str(tmp_path / "ck")
+    job = _ckpt_job(ckpt)
+    _run_until(job, g, die_at_block=6)
+    victim = sorted(f for f in os.listdir(ckpt) if f.endswith(".npy"))[0]
+    good_cursor = json.load(
+        open(os.path.join(ckpt + ".old", "manifest.json")))["cursors"]
+    with open(os.path.join(ckpt, victim), "r+b") as f:
+        f.truncate(8)
+    with pytest.warns(RuntimeWarning, match="sha256 mismatch"):
+        resumed = runner.run_similarity(job, source=ArraySource(g))
+    # The good generation now sits in the LATEST slot (advanced by the
+    # resumed run's own saves past the old cursor), the corrupt one is
+    # preserved aside, and the fallback slot is alive again.
+    assert os.path.isdir(ckpt + ".corrupt")
+    assert json.load(
+        open(os.path.join(ckpt, "manifest.json")))["cursors"] != good_cursor
+    from spark_examples_tpu.core.checkpoint import load
+
+    assert load(ckpt, "ibs", [f"S{i:06d}" for i in range(16)]) is not None
+    clean = runner.run_similarity(
+        JobConfig(ingest=IngestConfig(block_variants=128),
+                  compute=ComputeConfig(metric="ibs")),
+        source=ArraySource(g),
+    )
+    np.testing.assert_array_equal(resumed.similarity, clean.similarity)
+
+
+def test_reopen_failure_consumes_retry_budget(rng):
+    """A reopen() that itself fails on a still-flaky mount must burn
+    the same budget and raise the same cursor-naming exhaustion error
+    as a failed read — never escape as a raw OSError."""
+    g = random_genotypes(rng, 8, 512)
+
+    def always_dead():
+        raise IOError("mount still down")
+
+    class DeadMapping(ArraySource):
+        def blocks(self, block_variants, start_variant=0):
+            raise IOError("stale mapping")
+            yield  # pragma: no cover
+
+    src = RetryingSource(
+        DeadMapping(g),
+        policy=RetryPolicy(max_retries=2, backoff_s=0.001),
+        reopen=always_dead,
+    )
+    with pytest.raises(IngestExhaustedError, match="cursor 0"):
+        with pytest.warns(RuntimeWarning, match="transient ingest error"):
+            list(src.blocks(128))
+
+
+def test_injected_truncation_at_write_site(tmp_path, rng):
+    """The same fallback, driven end to end by the injection harness:
+    the checkpoint.tile_write site truncates a file AFTER its sha256
+    was recorded — exactly a torn write — and load() must reject that
+    generation and restore from .old."""
+    from spark_examples_tpu.core import checkpoint as ckpt_mod
+
+    g = random_genotypes(rng, 16, 1024, missing_rate=0.1)
+    ckpt = str(tmp_path / "ck")
+    job = _ckpt_job(ckpt)
+    n_files_per_save = 4  # ibs pieces, replicated layout
+    with faults.armed([
+        # Saves land at blocks 2, 4, 6; corrupt a file of the THIRD
+        # (final) save so the latest generation is the bad one and the
+        # retained save-2 generation is the .old fallback target.
+        f"checkpoint.tile_write:truncate:after={2 * n_files_per_save + 1}:max=1",
+    ]) as inj:
+        _run_until(job, g, die_at_block=6)
+        assert inj.fire_count("checkpoint.tile_write") == 1
+    with pytest.warns(RuntimeWarning, match="sha256 mismatch|falling back"):
+        restored = ckpt_mod.load(ckpt, "ibs", ArraySource(g).sample_ids,
+                                 block_variants=128)
+    assert restored is not None
+    resumed = runner.run_similarity(job, source=ArraySource(g))
+    clean = runner.run_similarity(
+        JobConfig(ingest=IngestConfig(block_variants=128),
+                  compute=ComputeConfig(metric="ibs")),
+        source=ArraySource(g),
+    )
+    np.testing.assert_array_equal(resumed.similarity, clean.similarity)
+
+
+def test_all_generations_corrupt_raises(tmp_path, rng):
+    from spark_examples_tpu.core import checkpoint as ckpt_mod
+    from spark_examples_tpu.core.checkpoint import CheckpointCorruptError
+
+    g = random_genotypes(rng, 16, 1024)
+    ckpt = str(tmp_path / "ck")
+    _run_until(_ckpt_job(ckpt), g, die_at_block=6)
+    for gen in (ckpt, ckpt + ".old"):
+        victim = sorted(f for f in os.listdir(gen) if f.endswith(".npy"))[0]
+        with open(os.path.join(gen, victim), "r+b") as f:
+            f.truncate(4)
+    with pytest.raises(CheckpointCorruptError, match="sha256 mismatch"):
+        ckpt_mod.load(ckpt, "ibs", ArraySource(g).sample_ids,
+                      block_variants=128)
+
+
+def test_corrupt_manifest_falls_back(tmp_path, rng):
+    g = random_genotypes(rng, 16, 1024)
+    ckpt = str(tmp_path / "ck")
+    _run_until(_ckpt_job(ckpt), g, die_at_block=6)
+    with open(os.path.join(ckpt, "manifest.json"), "w") as f:
+        f.write('{"truncated": tru')  # torn JSON
+    from spark_examples_tpu.core import checkpoint as ckpt_mod
+
+    with pytest.warns(RuntimeWarning, match="manifest unreadable"):
+        restored = ckpt_mod.load(ckpt, "ibs", ArraySource(g).sample_ids,
+                                 block_variants=128)
+    assert restored is not None
+    _acc, cursor, _stats = restored
+    assert cursor > 0  # a real earlier generation, not a fresh start
+
+
+def test_legacy_checkpoint_without_checksums_loads(tmp_path, rng):
+    """Pre-integrity checkpoints (no sha256 map) must keep loading."""
+    from spark_examples_tpu.core import checkpoint as ckpt_mod
+
+    ids = [f"s{i}" for i in range(8)]
+    ckpt_mod.save(str(tmp_path / "c"), {"m": np.zeros((8, 8))}, 64, "ibs",
+                  64, ids)
+    manifest_path = os.path.join(str(tmp_path / "c"), "manifest.json")
+    manifest = json.load(open(manifest_path))
+    del manifest["sha256"]
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    # leaf-schema check needs the real ibs pieces; bypass via direct load
+    with pytest.raises(ValueError, match="stale accumulator schema"):
+        ckpt_mod.load(str(tmp_path / "c"), "ibs", ids)
+
+
+# ----------------------------------------------------- kill + resume (subproc)
+
+
+_KILL_JOB = r"""
+import sys
+import numpy as np
+from spark_examples_tpu.core.virtual import force_virtual_cpu
+force_virtual_cpu(2)
+from spark_examples_tpu.core.config import (
+    ComputeConfig, IngestConfig, JobConfig,
+)
+from spark_examples_tpu.pipelines import runner
+
+job = JobConfig(
+    ingest=IngestConfig(source="packed", path=sys.argv[3],
+                        block_variants=128),
+    compute=ComputeConfig(metric="ibs", checkpoint_dir=sys.argv[1],
+                          checkpoint_every_blocks=2),
+)
+res = runner.run_similarity(job)
+np.save(sys.argv[2], res.similarity)
+"""
+
+
+def test_process_kill_resumes_from_checkpoint(tmp_path, rng):
+    """An injected os._exit mid-stream (the 'kill' kind, armed via the
+    environment as a real operator would) leaves a checkpoint a second
+    invocation resumes from, matching the clean run bit-exactly. Uses a
+    packed store: file-backed sources get the retry wrapper whose
+    block-read site hosts the injection."""
+    from spark_examples_tpu.ingest.packed import save_packed
+
+    g = np.abs(random_genotypes(rng, 16, 1024, missing_rate=0.1))
+    store = str(tmp_path / "store")
+    save_packed(store, g, bits=2)
+    ckpt = str(tmp_path / "ck")
+    out = str(tmp_path / "sim.npy")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    # Kill at the 6th block read: checkpoints exist for blocks 2 and 4.
+    env[faults.ENV_SPECS] = "ingest.block_read:kill:after=5:max=1"
+    p = subprocess.run(
+        [sys.executable, "-c", _KILL_JOB, ckpt, out, store],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert p.returncode == faults.KILL_EXIT_CODE, (p.returncode, p.stderr[-2000:])
+    assert os.path.exists(os.path.join(ckpt, "manifest.json"))
+    assert not os.path.exists(out)
+
+    env.pop(faults.ENV_SPECS)
+    p = subprocess.run(
+        [sys.executable, "-c", _KILL_JOB, ckpt, out, store],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    resumed = np.load(out)
+
+    clean_out = str(tmp_path / "clean.npy")
+    p = subprocess.run(
+        [sys.executable, "-c", _KILL_JOB, str(tmp_path / "nock"), clean_out,
+         store],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    np.testing.assert_array_equal(resumed, np.load(clean_out))
+
+    # The injection site fires INSIDE the retry boundary, so the
+    # checkpoint the killed run left holds exactly the blocks it
+    # completed — re-verify the cursor is block-grid aligned.
+    manifest = json.load(open(os.path.join(ckpt, "manifest.json")))
+    assert manifest["next_variant"] % 128 == 0
+
+
+# --------------------------------------------------- consensus under faults
+
+
+def test_consensus_delay_straggler_is_absorbed(rng):
+    """A straggling control plane (delay faults at the consensus site)
+    must slow the stream, not desynchronize or corrupt it. Runs the
+    multi-host feeder in its single-process degenerate form — the
+    2-process coverage lives in tests/test_distributed.py."""
+    from spark_examples_tpu.core import meshes
+    from spark_examples_tpu.parallel import gram_sharded, multihost as mh
+
+    g = np.abs(random_genotypes(rng, 8, 512, missing_rate=0.0))
+    src = ArraySource(g)
+    mesh = meshes.make_mesh()
+    plan = gram_sharded.plan_for(mesh, 8, "ibs", "variant")
+    stats: dict = {}
+
+    def drain():
+        widths = []
+        for gblock, meta in mh.stream_global_blocks(
+            src, 128, 0, plan, pack=False, stats=stats
+        ):
+            widths.append((np.asarray(gblock.addressable_data(0)).shape,
+                           None if meta is None else meta.stop))
+        return widths
+
+    clean = drain()
+    with faults.armed(
+        ["multihost.consensus:delay:delay=0.02:max=0"]
+    ) as inj:
+        delayed = drain()
+        assert inj.fire_count("multihost.consensus") >= 2
+    assert delayed == clean
