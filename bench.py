@@ -166,6 +166,7 @@ def _config1_job(store: str):
 def streamed_run(store: str) -> dict:
     """Config 1, the real pipeline end to end: packed store -> pcoa_job
     (device-resident finalize/eigh; only coords come home)."""
+    from spark_examples_tpu.core import telemetry
     from spark_examples_tpu.pipelines.jobs import pcoa_job
 
     job = _config1_job(store)
@@ -174,9 +175,15 @@ def streamed_run(store: str) -> dict:
     # (persistent-cached across bench invocations anyway).
     pcoa_job(job, source=_slice_store(store, 2 * BLOCK))
 
+    # Telemetry covers exactly the timed run: the warm run's counters,
+    # histograms, and span events would otherwise pollute the digest
+    # (and the exported derived throughputs would stop agreeing with
+    # this run's PhaseTimer report).
+    telemetry.reset()
     t0 = time.perf_counter()
     out = pcoa_job(job)
     total_s = time.perf_counter() - t0
+    digest = telemetry.digest()
     rep = out.timer.report()
     log(
         f"streamed pipeline: total {total_s:.2f}s | gram {rep.get('gram', 0):.2f}s "
@@ -187,7 +194,7 @@ def streamed_run(store: str) -> dict:
         + json.dumps({k: round(v, 3) for k, v in rep.items()})
     )
     return {"total_s": total_s, "coords": out.coords, "report": rep,
-            "n_variants": out.n_variants}
+            "n_variants": out.n_variants, "telemetry": digest}
 
 
 class StagedCohort:
@@ -788,12 +795,51 @@ def check_structure(coords: np.ndarray) -> float:
     return between / within
 
 
+def _argv_value(flag: str) -> str | None:
+    """Both GNU forms: ``--flag value`` and ``--flag=value``. A present
+    flag with a missing/empty/flag-like value aborts up front — arming
+    nothing silently (or exporting into a literal ``./--chaos/``) loses
+    the whole multi-config run's telemetry, the exact failure this
+    helper exists to prevent."""
+    for i, arg in enumerate(sys.argv):
+        value = None
+        if arg == flag:
+            if i + 1 < len(sys.argv):
+                value = sys.argv[i + 1]
+        elif arg.startswith(flag + "="):
+            value = arg[len(flag) + 1:]
+        else:
+            continue
+        if not value or value.startswith("-"):
+            raise SystemExit(f"bench: {flag} requires a value "
+                             f"(got {value!r})")
+        return value
+    return None
+
+
 def main() -> None:
+    from spark_examples_tpu.core import telemetry
+
+    telemetry_dir = _argv_value("--telemetry-dir")
+    if telemetry_dir:
+        telemetry.configure(dir=telemetry_dir, trace_events=True)
+
     store = cohort_store()
     tunnel = measure_tunnel()
     log(f"host->device tunnel this session: {tunnel:.1f} MB/s")
 
     streamed = streamed_run(store)
+    if telemetry_dir:
+        # Exported HERE so trace.jsonl / metrics.json describe exactly
+        # the config-1 streamed run (streamed_run reset the registry
+        # before its timed section; the staged/proxy configs below time
+        # themselves outside the PhaseTimer pipeline). Event buffering
+        # is then switched off: nothing exports again, so later configs
+        # would only accumulate dead events toward the 500k cap.
+        exported = telemetry.export()
+        if exported:
+            log(f"telemetry -> {exported}")
+        telemetry.configure(dir=telemetry_dir, trace_events=False)
     cohort = StagedCohort(store)
     staged = staged_run(cohort)
     autosomes = measured_autosomes(cohort)
@@ -907,6 +953,13 @@ def main() -> None:
         "ingest_mb_s_packed": round(rep.get("ingest_mb_per_s", 0.0), 1),
         "tunnel_mb_s": round(tunnel, 1),
         "cpu_baseline_s": round(base["total_s"], 1),
+        # Compact telemetry digest of the streamed config-1 run (always
+        # collected — the registry is process-wide; --telemetry-dir
+        # additionally exports the full trace/metrics files): per-block
+        # p50/p95, the prefetch stall fraction (host-read wait the chip
+        # actually paid), absorbed ingest retries, and consensus-wait
+        # p95 (0 in single-process runs).
+        "telemetry": streamed["telemetry"],
     }
     if "chaos" in configs:
         headline["chaos_ok"] = configs["chaos"].get(

@@ -124,6 +124,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--output-path", default=None)
     p.add_argument("--timings", action="store_true",
                    help="print per-phase timing JSON to stderr")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="export structured telemetry: per-rank Chrome "
+                   "trace events (rank<k>/trace.jsonl, loadable in "
+                   "Perfetto / chrome://tracing), a metrics registry "
+                   "dump (rank<k>/metrics.json: counters, gauges, "
+                   "p50/p95/p99 histograms, derived throughputs), and "
+                   "a merged summary table on rank 0 (see README "
+                   "'Observability')")
+    p.add_argument("--trace-events", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="buffer per-block span events into "
+                   "trace.jsonl (--no-trace-events keeps the "
+                   "metrics.json export but skips the event timeline "
+                   "for very long streams)")
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler trace of the job into this "
                    "directory (view with tensorboard's profile plugin)")
@@ -139,6 +153,10 @@ def _job_from_args(args) -> JobConfig:
         i, j = args.mesh_shape.lower().split("x")
         mesh_shape = (int(i), int(j))
     return JobConfig(
+        telemetry=config.TelemetryConfig(
+            dir=args.telemetry_dir,
+            trace_events=args.trace_events,
+        ),
         ingest=IngestConfig(
             source=args.source,
             path=args.path,
@@ -315,15 +333,29 @@ def main(argv: list[str] | None = None) -> int:
 
     import contextlib
 
-    from spark_examples_tpu.core import profiling
+    from spark_examples_tpu.core import profiling, telemetry
     from spark_examples_tpu.pipelines import jobs as J
     from spark_examples_tpu.pipelines.runner import build_source
 
     # --trace-dir wraps the whole job in a jax.profiler capture (the
     # Spark-web-UI replacement, SURVEY.md §5); exit stack so every
-    # command path below stops the trace on its way out.
+    # command path below stops the trace on its way out. --telemetry-dir
+    # arms the structured-telemetry layer the same way: configured
+    # before the job, exported on every exit path (including
+    # BrokenPipeError) so a piped-and-truncated run still leaves its
+    # trace behind.
     with contextlib.ExitStack() as stack:
         stack.enter_context(profiling.trace(getattr(args, "trace_dir", None)))
+        if job.telemetry.dir:
+            telemetry.configure(dir=job.telemetry.dir,
+                                trace_events=job.telemetry.trace_events)
+
+            def _export_telemetry():
+                d = telemetry.export()
+                if d:
+                    print(f"telemetry -> {d}", file=sys.stderr)
+
+            stack.callback(_export_telemetry)
         try:
             return _dispatch(args, parser, job, J, build_source)
         except BrokenPipeError:

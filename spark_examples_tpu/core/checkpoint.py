@@ -52,7 +52,7 @@ import warnings
 import jax
 import numpy as np
 
-from spark_examples_tpu.core import faults
+from spark_examples_tpu.core import faults, telemetry
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -126,6 +126,7 @@ def _vote_all_ok(local_ok: bool, make_peer_error) -> None:
         raise make_peer_error([int(i) for i in np.flatnonzero(oks == 0)])
 
 
+@telemetry.traced("checkpoint.save", cat="checkpoint")
 def save(
     path: str,
     acc: dict,
@@ -183,9 +184,12 @@ def save(
 
     def _write(fname: str, host: np.ndarray) -> None:
         fpath = os.path.join(tmp, fname)
-        with open(fpath, "wb") as f:
-            tee = _TeeHashWriter(f)
-            np.save(tee, host)
+        with telemetry.span("checkpoint.write", cat="checkpoint",
+                            file=fname):
+            with open(fpath, "wb") as f:
+                tee = _TeeHashWriter(f)
+                np.save(tee, host)
+        telemetry.count("checkpoint.bytes_written", float(host.nbytes))
         checksums[fname] = tee.sha256.hexdigest()
         faults.fire("checkpoint.tile_write", path=fpath)
 
@@ -280,12 +284,13 @@ def save(
             # falls back to the previous good state instead of restarting
             # the job from zero. A crash mid-sequence still leaves either
             # `path` or `path.old` intact (load() checks both).
-            old = path + ".old"
-            if os.path.exists(old):
-                shutil.rmtree(old)
-            if os.path.exists(path):
-                os.replace(path, old)
-            os.replace(tmp, path)
+            with telemetry.span("checkpoint.rotate", cat="checkpoint"):
+                old = path + ".old"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                if os.path.exists(path):
+                    os.replace(path, old)
+                os.replace(tmp, path)
         except Exception as e:
             primary_error = e
     _vote_all_ok(primary_error is None, lambda bad: RuntimeError(
@@ -347,6 +352,7 @@ def _local_files(manifest: dict, plan, sums: dict) -> list[str]:
     return sorted(f for f in sums if f in mine)
 
 
+@telemetry.traced("checkpoint.verify", cat="checkpoint")
 def _verify_files(path: str, manifest: dict, plan=None) -> str | None:
     """Re-hash this process's data files against the manifest; a reason
     string on the first mismatch/unreadable file, None when all verify.
@@ -548,6 +554,12 @@ def _promote_fallback(path: str, found):
             f"cannot promote fallback checkpoint generation {gen} back "
             f"to {path}: {err}"
         )
+    # Counted only once the promotion actually succeeded on every
+    # process — the single funnel every adopted resume-from-.old passes
+    # through; a failed promotion aborts the job and must not inflate
+    # the adopted-fallback count in post-mortem metrics.
+    telemetry.count("checkpoint.fallback")
+    telemetry.event("checkpoint.fallback", cat="checkpoint", generation=gen)
     return path, manifest
 
 
@@ -568,77 +580,83 @@ def load(path: str, metric: str, sample_ids: list[str],
     skip variants; a resume under a different mesh/mode would need a
     re-tiling no interrupted job should do implicitly.
     """
-    try:
-        mine, local_error = _usable_generation(path, plan), None
-    except CheckpointCorruptError as e:
-        # Don't raise yet in multi-host: peers may already be in the
-        # agreement allgather — vote the corruption instead so every
-        # process aborts together (_agree_generation re-raises it).
-        mine, local_error = None, e
-    found = _agree_generation(path, mine, local_error, plan)
-    if found is None:
-        return None
-    path, manifest = _promote_fallback(path, found)
-    if block_variants is not None and manifest["block_variants"] != block_variants:
-        raise ValueError(
-            f"checkpoint at {path} was written with --block-variants "
-            f"{manifest['block_variants']}, job wants {block_variants}; "
-            "resume must keep the same block grid"
-        )
-    if manifest["metric"] != metric:
-        raise ValueError(
-            f"checkpoint at {path} is for metric {manifest['metric']!r}, "
-            f"job wants {metric!r}"
-        )
-    if manifest["sample_hash"] != _sample_hash(sample_ids):
-        raise ValueError(
-            f"checkpoint at {path} was built for a different cohort "
-            f"({manifest['n_samples']} samples)"
-        )
-    from spark_examples_tpu.ops import gram
-
-    expected = sorted(
-        ("zz", "nvar") if metric == "grm" else gram.PIECES_FOR_METRIC[metric]
-    )
-    if manifest["leaves"] != expected:
-        raise ValueError(
-            f"checkpoint at {path} holds accumulator leaves "
-            f"{manifest['leaves']} but this version expects {expected} "
-            f"for metric {metric!r} (stale accumulator schema — delete "
-            "the checkpoint to restart)"
-        )
-    layout = manifest.get("layout") or {k: "full" for k in manifest["leaves"]}
-    # Cursors are per-process offsets into per-process ingest
-    # partitions, so a resume under a DIFFERENT process count would
-    # misapply every cursor regardless of leaf layout — reject it
-    # outright (re-partitioning a partial sum is never implicit).
-    if manifest.get("process_count", 1) != jax.process_count():
-        raise ValueError(
-            f"checkpoint at {path} was written by "
-            f"{manifest.get('process_count', 1)} process(es); this job "
-            f"runs {jax.process_count()} — per-process ingest cursors "
-            "do not transfer across process counts"
-        )
-    if any(v == "tiles" for v in layout.values()):
-        want_mesh = list(plan.mesh.devices.shape) if plan is not None else None
-        if (
-            plan is None
-            or manifest.get("mesh_shape") != want_mesh
-            or manifest.get("mode") != plan.mode
-        ):
+    # Span inlined rather than @telemetry.traced: the fallback/corruption
+    # warnings in this load path use stacklevel=3 tuned to land on
+    # load()'s CALLER, and a decorator's wrapper frame would re-attribute
+    # every operator-facing warning to telemetry.py (and break
+    # module-keyed warning filters).
+    with telemetry.span("checkpoint.load", cat="checkpoint"):
+        try:
+            mine, local_error = _usable_generation(path, plan), None
+        except CheckpointCorruptError as e:
+            # Don't raise yet in multi-host: peers may already be in the
+            # agreement allgather — vote the corruption instead so every
+            # process aborts together (_agree_generation re-raises it).
+            mine, local_error = None, e
+        found = _agree_generation(path, mine, local_error, plan)
+        if found is None:
+            return None
+        path, manifest = _promote_fallback(path, found)
+        if block_variants is not None and manifest["block_variants"] != block_variants:
             raise ValueError(
-                f"checkpoint at {path} is tiled for mesh "
-                f"{manifest.get('mesh_shape')} mode "
-                f"{manifest.get('mode')!r}; this job runs mesh "
-                f"{want_mesh} mode {getattr(plan, 'mode', None)!r} — "
-                "resume must keep the tile grid (re-tiling a partial "
-                "sum is never implicit)"
+                f"checkpoint at {path} was written with --block-variants "
+                f"{manifest['block_variants']}, job wants {block_variants}; "
+                "resume must keep the same block grid"
             )
-    acc = {
-        k: _load_leaf(path, k, layout.get(k, "full"), manifest, plan)
-        for k in manifest["leaves"]
-    }
-    cursors = manifest.get("cursors") or {"0": manifest["next_variant"]}
-    proc = jax.process_index() if jax.process_count() > 1 else 0
-    cursor = int(cursors.get(str(proc), manifest["next_variant"]))
-    return acc, cursor, manifest.get("stream_stats", {})
+        if manifest["metric"] != metric:
+            raise ValueError(
+                f"checkpoint at {path} is for metric {manifest['metric']!r}, "
+                f"job wants {metric!r}"
+            )
+        if manifest["sample_hash"] != _sample_hash(sample_ids):
+            raise ValueError(
+                f"checkpoint at {path} was built for a different cohort "
+                f"({manifest['n_samples']} samples)"
+            )
+        from spark_examples_tpu.ops import gram
+
+        expected = sorted(
+            ("zz", "nvar") if metric == "grm" else gram.PIECES_FOR_METRIC[metric]
+        )
+        if manifest["leaves"] != expected:
+            raise ValueError(
+                f"checkpoint at {path} holds accumulator leaves "
+                f"{manifest['leaves']} but this version expects {expected} "
+                f"for metric {metric!r} (stale accumulator schema — delete "
+                "the checkpoint to restart)"
+            )
+        layout = manifest.get("layout") or {k: "full" for k in manifest["leaves"]}
+        # Cursors are per-process offsets into per-process ingest
+        # partitions, so a resume under a DIFFERENT process count would
+        # misapply every cursor regardless of leaf layout — reject it
+        # outright (re-partitioning a partial sum is never implicit).
+        if manifest.get("process_count", 1) != jax.process_count():
+            raise ValueError(
+                f"checkpoint at {path} was written by "
+                f"{manifest.get('process_count', 1)} process(es); this job "
+                f"runs {jax.process_count()} — per-process ingest cursors "
+                "do not transfer across process counts"
+            )
+        if any(v == "tiles" for v in layout.values()):
+            want_mesh = list(plan.mesh.devices.shape) if plan is not None else None
+            if (
+                plan is None
+                or manifest.get("mesh_shape") != want_mesh
+                or manifest.get("mode") != plan.mode
+            ):
+                raise ValueError(
+                    f"checkpoint at {path} is tiled for mesh "
+                    f"{manifest.get('mesh_shape')} mode "
+                    f"{manifest.get('mode')!r}; this job runs mesh "
+                    f"{want_mesh} mode {getattr(plan, 'mode', None)!r} — "
+                    "resume must keep the tile grid (re-tiling a partial "
+                    "sum is never implicit)"
+                )
+        acc = {
+            k: _load_leaf(path, k, layout.get(k, "full"), manifest, plan)
+            for k in manifest["leaves"]
+        }
+        cursors = manifest.get("cursors") or {"0": manifest["next_variant"]}
+        proc = jax.process_index() if jax.process_count() > 1 else 0
+        cursor = int(cursors.get(str(proc), manifest["next_variant"]))
+        return acc, cursor, manifest.get("stream_stats", {})

@@ -156,9 +156,27 @@ class ComputeConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Structured-telemetry export (core/telemetry.py).
+
+    ``dir`` set turns the layer on: spans + metrics are exported as
+    ``<dir>/rank<k>/{trace.jsonl,metrics.json}`` (trace.jsonl loads
+    directly in Perfetto / chrome://tracing) plus a merged summary
+    table on rank 0. ``trace_events=False`` keeps the metrics export
+    but skips buffering per-block span events (metrics-only mode for
+    very long streams). Metrics *collection* is always on regardless —
+    this only controls export and event buffering.
+    """
+
+    dir: str | None = None
+    trace_events: bool = True
+
+
+@dataclass
 class JobConfig:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     compute: ComputeConfig = field(default_factory=ComputeConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     output_path: str | None = None
     # pcoa only: persist the fitted embedding (eigenpairs + centering
     # statistics) so `project` can later place NEW samples into this

@@ -60,6 +60,8 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass
 
+from spark_examples_tpu.core import telemetry
+
 ENV_SPECS = "SPARK_EXAMPLES_TPU_FAULTS"
 ENV_SEED = "SPARK_EXAMPLES_TPU_FAULT_SEED"
 
@@ -160,6 +162,11 @@ class Injector:
                 break
         if spec is None:
             return
+        # Observable firings: the counter makes a chaos run's injected-
+        # fault count part of its metrics, and the instant event pins
+        # each firing to the trace timeline next to whatever it broke.
+        telemetry.count("faults.fired")
+        telemetry.event("fault", cat="faults", site=site, kind=spec.kind)
         self._execute(spec, site, path)
 
     @staticmethod

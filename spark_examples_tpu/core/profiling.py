@@ -14,11 +14,12 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import json
-import time
 from dataclasses import dataclass, field
 
 import jax
 import numpy as np
+
+from spark_examples_tpu.core import telemetry
 
 
 def _leaf_sum_program(leaf):
@@ -36,7 +37,6 @@ def _leaf_sum_program(leaf):
 # the one-time trace+compile charge amortizes across every phase instead
 # of re-paying per distinct tree signature.
 _leaf_sum = jax.jit(_leaf_sum_program)
-_warned_fallback = False
 
 
 def _expand_dataclasses(leaf):
@@ -89,13 +89,16 @@ def hard_sync(tree):
     except Exception as e:
         # Mixed-mesh / committed-device trees whose scalars can't be
         # combined in one place: fall back to one element per shard.
-        # Warn ONCE — the fallback pays a host round-trip per shard per
-        # leaf, the exact per-phase timing inflation the checksum path
-        # exists to remove, and silent degradation would quietly deflate
-        # every reported TFLOP/s number.
-        global _warned_fallback
-        if not _warned_fallback:
-            _warned_fallback = True
+        # Warn once per telemetry reset — the fallback pays a host
+        # round-trip per shard per leaf, the exact per-phase timing
+        # inflation the checksum path exists to remove, and silent
+        # degradation would quietly deflate every reported TFLOP/s
+        # number. Every occurrence counts into the "hard_sync.fallback"
+        # telemetry counter (so a degraded run is visible in metrics
+        # long after the one warning scrolled away), and
+        # ``telemetry.reset()`` re-arms the warning — testable, unlike
+        # the old module-global latch.
+        if telemetry.count("hard_sync.fallback") == 1.0:
             import warnings
 
             warnings.warn(
@@ -116,23 +119,49 @@ def hard_sync(tree):
     return tree
 
 
+# Registry counter -> report key for resilience incidents surfaced by
+# PhaseTimer.report(). The registry is process-wide, so each timer
+# snapshots these at construction and reports only the DELTA — a retry
+# absorbed by an earlier run in the same process must not show up as a
+# phantom incident in every later timer's report.
+_INCIDENT_COUNTERS = (
+    ("ingest.retries", "ingest_retries"),
+    ("ingest.reopens", "ingest_reopens"),
+    ("ingest.corrupt_blocks", "ingest_corrupt_blocks"),
+)
+
+
 @dataclass
 class PhaseTimer:
     """Accumulates named phase durations; durations are wall-clock with
-    ``block_until_ready`` applied to whatever the phase returns."""
+    ``block_until_ready`` applied to whatever the phase returns.
+
+    Every phase duration and counter is mirrored into the process-wide
+    telemetry registry (core/telemetry.py: counter ``phase.<name>`` plus
+    a same-named span on the trace timeline), which is what lets the
+    exporter derive the identical throughputs this timer reports."""
 
     phases: dict[str, float] = field(default_factory=dict)
     counters: dict[str, float] = field(default_factory=dict)
+    incident_base: dict[str, float] = field(default_factory=dict,
+                                            repr=False, compare=False)
+
+    def __post_init__(self):
+        if not self.incident_base:
+            self.incident_base = {
+                name: telemetry.counter_value(name)
+                for name, _ in _INCIDENT_COUNTERS
+            }
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        t0 = time.perf_counter()
+        sp = telemetry.begin("phase." + name, cat="phase")
         try:
             yield
         finally:
-            self.phases[name] = self.phases.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
+            dt = sp.end()
+            self.phases[name] = self.phases.get(name, 0.0) + dt
+            telemetry.count("phase." + name, dt)
 
     def timed(self, name: str, fn, *args, **kwargs):
         with self.phase(name):
@@ -142,33 +171,25 @@ class PhaseTimer:
 
     def add(self, counter: str, amount: float) -> None:
         self.counters[counter] = self.counters.get(counter, 0.0) + amount
+        telemetry.count(counter, amount)
 
     def report(self) -> dict:
         rep: dict[str, float] = dict(self.phases)
-        # Derived throughput metrics where the raw counters exist. The
-        # streaming-PCoA refresh hook runs *inside* the gram loop, so
-        # its wall-clock (tracked as "stream_refresh") is subtracted
-        # before dividing — otherwise config-5 runs would report
-        # deflated Gram GFLOPS / ingest MB/s and hide exactly the
-        # overhead the phase exists to expose.
-        refresh_t = self.phases.get("stream_refresh", 0.0)
-        gram_t = max(self.phases.get("gram", 0.0) - refresh_t, 0.0)
-        if "gram_flops" in self.counters and gram_t:
-            rep["gram_gflops_per_s"] = (
-                self.counters["gram_flops"] / gram_t / 1e9
-            )
-        # Ingest bytes are counted wherever streaming happens — a
-        # dedicated "ingest" phase if one exists, else the gram loop
-        # (whose wall-clock includes the overlapped host reads).
-        stream_t = self.phases.get("ingest") or gram_t
-        if "ingest_bytes" in self.counters and stream_t:
-            rep["ingest_mb_per_s"] = (
-                self.counters["ingest_bytes"] / stream_t / 1e6
-            )
-        if "eigh_flops" in self.counters and self.phases.get("eigh"):
-            rep["eigh_gflops_per_s"] = (
-                self.counters["eigh_flops"] / self.phases["eigh"] / 1e9
-            )
+        # Derived throughputs: the one shared formula (telemetry.
+        # derive_throughputs) — the exporter's metrics.json and this
+        # report can only agree.
+        rep.update(telemetry.derive_throughputs(self.phases, self.counters))
+        # Resilience incidents (ingest/resilient.py counts them into the
+        # process-wide registry — it has no timer handle): a silently
+        # retrying run must be distinguishable from a clean one in the
+        # same --timings / bench output that reports its throughput.
+        # Delta against this timer's construction-time snapshot, so
+        # incidents belong to the run that owned the timer.
+        for cname, key in _INCIDENT_COUNTERS:
+            v = telemetry.counter_value(cname) - self.incident_base.get(
+                cname, 0.0)
+            if v > 0:
+                rep[key] = v
         return rep
 
     def dump(self) -> str:

@@ -1,0 +1,667 @@
+"""Structured telemetry: spans, metrics registry, per-rank Perfetto export.
+
+The reference leaned on the Spark web UI for stage/task metrics
+(core/profiling.py's note); the TPU-native rebuild had only an aggregate
+:class:`~spark_examples_tpu.core.profiling.PhaseTimer` — phase totals
+and three derived throughputs, no per-block timeline, no visibility into
+the retry/checkpoint/consensus machinery, no per-rank view in multihost
+runs. This module is the process-wide replacement, three layers:
+
+- **Spans** — nestable named intervals (category, monotonic t0/t1,
+  key=value attrs) recorded as Chrome trace-event objects, one JSON
+  object per line (``trace.jsonl``). The file loads directly in Perfetto
+  (https://ui.perfetto.dev) or ``chrome://tracing`` — both tokenizers
+  accept a sequence of event objects without the array wrapper. Each
+  rank is its own ``pid`` track; threads (the prefetch producer, the
+  main stream) are ``tid`` tracks within it. Every ended span also
+  feeds a same-named duration histogram, so the timeline and the
+  percentiles never disagree about what was measured.
+- **Metrics registry** — counters (monotonic float sums), gauges
+  (last/min/max), and streaming histograms: fixed log-spaced buckets
+  (``GROWTH`` per bucket), p50/p95/p99 by bucket walk — **no sample
+  retention**, so a 40M-variant stream costs the same memory as a toy
+  run. The registry subsumes ``PhaseTimer.counters``: the timer mirrors
+  every phase duration (``phase.<name>``) and counter into it, and
+  :func:`derive_throughputs` is the single shared formula both
+  ``PhaseTimer.report()`` and the exporter use — the two can only agree.
+- **Exporter** — ``<dir>/rank<k>/{trace.jsonl,metrics.json}`` per
+  process plus a merged human-readable ``summary.txt`` on rank 0
+  (best-effort merge of whatever peer ``metrics.json`` files are
+  visible on the shared filesystem — no collective at exit).
+
+Metrics are **always on** (a dict update per event — noise against a
+block's matmul); span *trace events* buffer only when tracing is enabled
+via :func:`configure` (``--telemetry-dir`` / ``--trace-events``), capped
+at :data:`MAX_EVENTS` with an overflow counter rather than unbounded
+growth.
+
+Every name used at an instrumentation site must be declared in
+:data:`NAMES` (families like ``phase.*`` cover dynamic suffixes);
+``tests/test_telemetry_names.py`` lints call sites against the registry
+so a typo'd metric name cannot silently fork a timeline, and unknown
+names at runtime warn once and count into ``telemetry.unknown_names``
+instead of raising mid-job.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import threading
+import time
+import warnings
+from contextlib import contextmanager
+
+# ---------------------------------------------------------------------------
+# Canonical name registry (THE source of truth — satellite: names lint).
+# kind is documentation + export section; membership is what the lint and
+# the runtime check enforce. Entries ending in ".*" declare a family.
+# Spans double as duration histograms under the same name (seconds).
+
+NAMES: dict[str, tuple[str, str]] = {
+    # -- spans ------------------------------------------------------------
+    "phase.*": (
+        "span",
+        "one PhaseTimer phase (gram/eigh/finalize/...) — wall-clock of the "
+        "named pipeline stage; also mirrored as a counter of summed seconds",
+    ),
+    "gram.block": (
+        "span",
+        "one block period of the streamed gram loop: producer/queue wait + "
+        "host->device transfer + update dispatch + hooks + checkpoint",
+    ),
+    "multihost.consensus": (
+        "span",
+        "one control-plane allgather round (step-count / has-data / "
+        "terminal agreement) — the wait is the per-rank straggler metric: "
+        "a fast rank burns its skew here",
+    ),
+    "checkpoint.save": ("span", "one checkpoint save (write + vote + rotate)"),
+    "checkpoint.write": ("span", "one checkpoint data file (hash-tee + np.save)"),
+    "checkpoint.verify": ("span", "sha256 re-hash of this rank's files on load"),
+    "checkpoint.rotate": ("span", "atomic generation rotation on the primary"),
+    "checkpoint.load": ("span", "one checkpoint load (verify + agree + place)"),
+    # -- instant events ---------------------------------------------------
+    "fault": ("event", "a fault-injection spec fired (args: site, kind)"),
+    "stream.snapshot": (
+        "event",
+        "streaming incremental-PCoA snapshot dispatched (args: n_variants)",
+    ),
+    "gram.pad_step": (
+        "event",
+        "multihost consensus step where this rank fed an all-MISSING "
+        "padding slab (its partition was exhausted) — deliberately NOT a "
+        "gram.block sample, so padding cannot skew the per-rank block "
+        "percentiles the straggler comparison reads",
+    ),
+    "checkpoint.fallback": (
+        "counter",
+        "loads that resumed from the .old generation (latest corrupt/missing); "
+        "also emitted as an instant event with the adopted generation",
+    ),
+    # -- counters ---------------------------------------------------------
+    "gram_flops": ("counter", "FLOPs credited to the gram accumulation"),
+    "ingest_bytes": ("counter", "bytes actually shipped host->device"),
+    "eigh_flops": ("counter", "FLOPs credited to the eigensolve"),
+    "ingest.retries": (
+        "counter",
+        "transient-IO retries absorbed by RetryingSource (a silently "
+        "retrying run is distinguishable from a clean one)",
+    ),
+    "ingest.reopens": ("counter", "inner-source rebuilds (reopen factory) before retries"),
+    "ingest.corrupt_blocks": ("counter", "corrupt blocks failed fast (never retried)"),
+    "ingest.exhausted": ("counter", "retry budgets exhausted (job-killing incidents)"),
+    "ingest.backoff_s": ("counter", "seconds slept in retry backoff"),
+    "checkpoint.bytes_written": ("counter", "checkpoint data bytes written by this rank"),
+    "faults.fired": ("counter", "fault-injection specs fired (all sites)"),
+    "hard_sync.fallback": (
+        "counter",
+        "hard_sync checksum-barrier failures that fell back to per-shard "
+        "element fetches (inflates every timed phase; warns once per reset)",
+    ),
+    "telemetry.dropped_events": ("counter", "trace events dropped past MAX_EVENTS"),
+    "telemetry.unknown_names": ("counter", "instrumentation calls with undeclared names"),
+    # -- gauges -----------------------------------------------------------
+    "prefetch.queue_depth": (
+        "gauge",
+        "prefetch queue occupancy sampled at each consumer get (max == "
+        "configured depth means the producer is ahead; 0 means the chip "
+        "is starved)",
+    ),
+    # -- histograms -------------------------------------------------------
+    "prefetch.put_wait_s": (
+        "histogram",
+        "producer-thread wait per block for queue space (large => consumer/"
+        "device is the bottleneck)",
+    ),
+    "prefetch.get_wait_s": (
+        "histogram",
+        "consumer wait per block for the producer (large => ingest is the "
+        "bottleneck; sum/gram time = the stall fraction)",
+    ),
+}
+
+_FAMILIES = tuple(n[:-1] for n in NAMES if n.endswith(".*"))  # "phase."
+
+KINDS = ("span", "event", "counter", "gauge", "histogram")
+
+MAX_EVENTS = 500_000
+
+# Histogram geometry: log buckets growing by GROWTH per step from LO.
+# 2**(1/8) per bucket => a quantile read off the geometric bucket
+# midpoint is within ~4.5% of the true sample quantile — tight enough
+# for p50/p95/p99 attribution with zero sample retention.
+HIST_LO = 1e-9
+HIST_GROWTH = 2.0 ** 0.125
+_HIST_BUCKETS = 1 + 8 * 47 + 1  # underflow + 47 octaves (1e-9..~1.4e5 s) + overflow
+_LOG_G = math.log(HIST_GROWTH)
+
+
+def is_declared(name: str) -> bool:
+    """True when ``name`` is in the registry (exact or family match)."""
+    return name in NAMES or name.startswith(_FAMILIES)
+
+
+class Histogram:
+    """Fixed log-bucket streaming histogram — no sample retention.
+
+    Exact count/sum/min/max ride along, and quantiles clamp into
+    [min, max], so a single-sample (or constant) histogram reports its
+    quantiles exactly.
+    """
+
+    __slots__ = ("buckets", "count", "sum", "min", "max")
+
+    def __init__(self):
+        self.buckets = [0] * _HIST_BUCKETS
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        if v <= HIST_LO:
+            i = 0
+        else:
+            i = min(1 + int(math.log(v / HIST_LO) / _LOG_G), _HIST_BUCKETS - 1)
+        self.buckets[i] += 1
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    @staticmethod
+    def _bounds(i: int) -> tuple[float, float]:
+        if i == 0:
+            return 0.0, HIST_LO
+        return HIST_LO * HIST_GROWTH ** (i - 1), HIST_LO * HIST_GROWTH ** i
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile (q in [0, 1]) read off the bucket grid."""
+        if self.count == 0:
+            return 0.0
+        target = max(q * self.count, 1e-12)
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            if not n:
+                continue
+            seen += n
+            if seen >= target:
+                lo, hi = self._bounds(i)
+                mid = math.sqrt(lo * hi) if lo > 0 else hi / 2.0
+                return min(max(mid, self.min), self.max)
+        return self.max
+
+    def summary(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": self.sum / self.count,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide state. One lock guards everything: per-event cost is a
+# dict update — noise against the block compute the events describe —
+# and sites fire from both the main thread and the prefetch producer.
+
+_lock = threading.Lock()
+_T0 = time.perf_counter()  # trace timestamp epoch (per process)
+_START_UNIX = time.time()  # wall-clock process start (summary staleness)
+
+_counters: dict[str, float] = {}
+_gauges: dict[str, dict] = {}
+_hists: dict[str, Histogram] = {}
+_events: list[dict] = []
+
+_dir: str | None = None
+_trace = False
+_warned_names: set[str] = set()
+
+
+def _check_name(name: str) -> None:
+    if is_declared(name):
+        return
+    with _lock:
+        _counters["telemetry.unknown_names"] = (
+            _counters.get("telemetry.unknown_names", 0.0) + 1.0
+        )
+        if name in _warned_names:
+            return
+        _warned_names.add(name)
+    warnings.warn(
+        f"telemetry name {name!r} is not declared in telemetry.NAMES — "
+        "declare it (the canonical registry is what keeps timelines from "
+        "silently forking on typos)",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
+def configure(dir: str | None = None, trace_events: bool = True) -> None:
+    """Enable export (and optionally span trace events) process-wide.
+
+    Metrics are always collected; this sets where :func:`export` writes
+    and whether spans buffer Chrome trace events (``trace_events=False``
+    keeps ``metrics.json`` but writes an events-free ``trace.jsonl``).
+    """
+    global _dir, _trace
+    with _lock:
+        _dir = dir
+        _trace = bool(trace_events) and dir is not None
+
+
+def reset() -> None:
+    """Zero every counter/gauge/histogram and drop buffered trace events
+    (configuration survives). Also re-arms every warn-once keyed on a
+    counter (e.g. the hard_sync fallback warning)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _events.clear()
+        _warned_names.clear()
+
+
+# ---------------------------------------------------------------------------
+# Recording API.
+
+
+def count(name: str, n: float = 1.0) -> float:
+    """Add ``n`` to counter ``name``; returns the new total (so call
+    sites can key warn-once behavior on the first increment)."""
+    _check_name(name)
+    with _lock:
+        total = _counters.get(name, 0.0) + n
+        _counters[name] = total
+    return total
+
+
+def counter_value(name: str) -> float:
+    with _lock:
+        return _counters.get(name, 0.0)
+
+
+def gauge_set(name: str, value: float) -> None:
+    _check_name(name)
+    v = float(value)
+    with _lock:
+        g = _gauges.get(name)
+        if g is None:
+            _gauges[name] = {"last": v, "min": v, "max": v, "n": 1}
+        else:
+            g["last"] = v
+            g["n"] += 1
+            if v < g["min"]:
+                g["min"] = v
+            if v > g["max"]:
+                g["max"] = v
+
+
+def observe(name: str, value: float) -> None:
+    """Record ``value`` into histogram ``name``."""
+    _check_name(name)
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = Histogram()
+        h.record(value)
+
+
+def _append_event(ev: dict) -> None:
+    with _lock:
+        if len(_events) >= MAX_EVENTS:
+            _counters["telemetry.dropped_events"] = (
+                _counters.get("telemetry.dropped_events", 0.0) + 1.0
+            )
+            return
+        _events.append(ev)
+
+
+def event(name: str, cat: str = "misc", **attrs) -> None:
+    """Instant event on the trace timeline (thread-scoped 'i' phase)."""
+    _check_name(name)
+    if not _trace:
+        return
+    _append_event({
+        "name": name,
+        "cat": cat,
+        "ph": "i",
+        "s": "t",
+        "ts": (time.perf_counter() - _T0) * 1e6,
+        "tid": threading.get_ident(),
+        "args": attrs,
+    })
+
+
+class SpanHandle:
+    """An open span: :meth:`end` records it (histogram + trace event),
+    :meth:`cancel` drops it. Explicit handles let loop bodies time the
+    full block *period* (producer wait included) without contorting the
+    iteration into a context manager."""
+
+    __slots__ = ("name", "cat", "t0", "tid", "_open")
+
+    def __init__(self, name: str, cat: str):
+        self.name = name
+        self.cat = cat
+        self.t0 = time.perf_counter()
+        self.tid = threading.get_ident()
+        self._open = True
+
+    def end(self, **attrs) -> float:
+        if not self._open:
+            return 0.0
+        self._open = False
+        t1 = time.perf_counter()
+        dur = t1 - self.t0
+        with _lock:
+            h = _hists.get(self.name)
+            if h is None:
+                h = _hists[self.name] = Histogram()
+            h.record(dur)
+        if _trace:
+            _append_event({
+                "name": self.name,
+                "cat": self.cat,
+                "ph": "X",
+                "ts": (self.t0 - _T0) * 1e6,
+                "dur": dur * 1e6,
+                "tid": self.tid,
+                "args": attrs,
+            })
+        return dur
+
+    def cancel(self) -> None:
+        self._open = False
+
+
+def begin(name: str, cat: str = "misc") -> SpanHandle:
+    _check_name(name)
+    return SpanHandle(name, cat)
+
+
+@contextmanager
+def span(name: str, cat: str = "misc", **attrs):
+    """``with telemetry.span("checkpoint.save", cat="checkpoint"):`` —
+    nestable (strict LIFO per thread, so the trace's time-containment
+    nesting is guaranteed by construction)."""
+    sp = begin(name, cat)
+    try:
+        yield sp
+    finally:
+        sp.end(**attrs)
+
+
+def traced(name: str, cat: str = "misc"):
+    """Decorator form of :func:`span` for whole-function spans."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(name, cat=cat):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Derived metrics — THE shared formula (PhaseTimer.report() calls this
+# too, which is what makes the exported throughputs agree with the
+# timer's by construction).
+
+
+def effective_gram_time(phases: dict) -> float:
+    """THE shared gram denominator: gram wall-clock minus the
+    streaming-PCoA refresh dispatch that runs *inside* the gram loop
+    ("stream_refresh") — otherwise config-5 runs would report deflated
+    Gram GFLOPS / ingest MB/s / inflated stall fractions and hide
+    exactly the overhead the phase exists to expose. Every consumer
+    (derive_throughputs, digest, the rank-0 summary) goes through here
+    so they cannot fork."""
+    return max(phases.get("gram", 0.0) - phases.get("stream_refresh", 0.0),
+               0.0)
+
+
+def stall_fraction(phases: dict, get_wait_sum: float) -> float:
+    """Fraction of the (effective) gram phase the consumer spent waiting
+    on the prefetch producer — the 'was the chip starved by ingest'
+    number."""
+    gram_t = effective_gram_time(phases)
+    return get_wait_sum / gram_t if gram_t else 0.0
+
+
+def derive_throughputs(phases: dict, counters: dict) -> dict:
+    """Derived throughput metrics where the raw counters exist."""
+    rep: dict[str, float] = {}
+    gram_t = effective_gram_time(phases)
+    if "gram_flops" in counters and gram_t:
+        rep["gram_gflops_per_s"] = counters["gram_flops"] / gram_t / 1e9
+    # Ingest bytes are counted wherever streaming happens — a dedicated
+    # "ingest" phase if one exists, else the gram loop (whose wall-clock
+    # includes the overlapped host reads).
+    stream_t = phases.get("ingest") or gram_t
+    if "ingest_bytes" in counters and stream_t:
+        rep["ingest_mb_per_s"] = counters["ingest_bytes"] / stream_t / 1e6
+    if "eigh_flops" in counters and phases.get("eigh"):
+        rep["eigh_gflops_per_s"] = counters["eigh_flops"] / phases["eigh"] / 1e9
+    return rep
+
+
+def _split_counters() -> tuple[dict, dict]:
+    """(phases, plain counters) from the mirrored registry state."""
+    with _lock:
+        counters = dict(_counters)
+    phases = {k[len("phase."):]: v for k, v in counters.items()
+              if k.startswith("phase.")}
+    plain = {k: v for k, v in counters.items() if not k.startswith("phase.")}
+    return phases, plain
+
+
+def digest() -> dict:
+    """The compact headline digest (bench.py): block-time p50/p95,
+    prefetch stall fraction, retries, consensus-wait p95."""
+    phases, counters = _split_counters()
+    with _lock:
+        block = _hists.get("gram.block")
+        stall = _hists.get("prefetch.get_wait_s")
+        consensus = _hists.get("multihost.consensus")
+        block = block.summary() if block else {"count": 0}
+        stall_sum = stall.sum if stall else 0.0
+        consensus_p95 = consensus.quantile(0.95) if consensus else 0.0
+    return {
+        "block_p50_s": round(block.get("p50", 0.0), 6),
+        "block_p95_s": round(block.get("p95", 0.0), 6),
+        "blocks": block.get("count", 0),
+        "prefetch_stall_frac": round(stall_fraction(phases, stall_sum), 4),
+        "ingest_retries": int(counters.get("ingest.retries", 0.0)),
+        "consensus_wait_p95_s": round(consensus_p95, 6),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Export.
+
+
+def _rank() -> tuple[int, int]:
+    """(process_index, process_count) — lazily, so importing this module
+    never initializes a jax backend (test bootstrap order matters)."""
+    try:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def metrics_snapshot() -> dict:
+    """The metrics.json payload (also handy for in-process assertions)."""
+    phases, counters = _split_counters()
+    with _lock:
+        gauges = {k: dict(v) for k, v in _gauges.items()}
+        hists = {k: h.summary() for k, h in _hists.items()}
+    return {
+        "counters": counters,
+        "phases": phases,
+        "gauges": gauges,
+        "histograms": hists,
+        "derived": derive_throughputs(phases, counters),
+    }
+
+
+def export(dir: str | None = None) -> str | None:
+    """Write ``rank<k>/{trace.jsonl,metrics.json}`` under ``dir`` (or the
+    configured directory), plus the merged ``summary.txt`` on rank 0.
+    Returns this rank's directory, or None when nothing is configured.
+
+    The summary merge is best-effort from whatever peer metrics.json
+    files are already visible (no collective at exit: telemetry must
+    never be able to hang a job that otherwise finished). An unwritable
+    directory or full disk warns and returns None instead of raising —
+    telemetry must never be able to FAIL a job (or discard a bench
+    run's results) that otherwise finished either."""
+    base = dir or _dir
+    if not base:
+        return None
+    try:
+        return _export(base)
+    except OSError as e:
+        warnings.warn(f"telemetry export to {base} failed: {e}",
+                      RuntimeWarning, stacklevel=2)
+        return None
+
+
+def _export(base: str) -> str:
+    rank, n_proc = _rank()
+    d = os.path.join(base, f"rank{rank}")
+    os.makedirs(d, exist_ok=True)
+
+    with _lock:
+        events = sorted(_events, key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+    with open(os.path.join(d, "trace.jsonl"), "w") as f:
+        meta = {"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+                "ts": 0, "args": {"name": f"rank {rank}"}}
+        f.write(json.dumps(meta) + "\n")
+        for ev in events:
+            # default=str: a site passing e.g. a numpy scalar attr must
+            # degrade to a stringified arg, not kill the export.
+            f.write(json.dumps({**ev, "pid": rank}, default=str) + "\n")
+
+    snap = metrics_snapshot()
+    snap["meta"] = {"rank": rank, "process_count": n_proc,
+                    "trace_events": len(events),
+                    "wrote_unix_s": time.time()}
+    with open(os.path.join(d, "metrics.json"), "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True, default=str)
+
+    if rank == 0:
+        try:
+            _write_summary(base, n_proc)
+        except OSError as e:  # summary is a convenience, never a failure
+            warnings.warn(f"telemetry summary not written: {e}",
+                          RuntimeWarning, stacklevel=2)
+    return d
+
+
+def _write_summary(base: str, n_proc: int) -> None:
+    """Human-readable per-rank table + consensus skew at ``base``.
+
+    Ranks are enumerated by index (0..n_proc-1), NOT by listdir, and a
+    peer file whose ``meta.wrote_unix_s`` predates this process's start
+    is treated as not-yet-exported: both guard the same failure — a
+    stale rank file left by a previous run in a reused directory
+    (rank 0 exports without a collective, so a slower peer's file from
+    the LAST run may still be sitting at the same path) would fabricate
+    exactly the straggler skew the summary exists to surface. The 5 s
+    slack absorbs wall-clock skew between hosts sharing the FS."""
+    rows = []
+    stale = 0
+    for rank in range(n_proc):
+        try:
+            with open(os.path.join(base, f"rank{rank}",
+                                   "metrics.json")) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if (rank != 0 and m.get("meta", {}).get("wrote_unix_s", 0.0)
+                < _START_UNIX - 5.0):
+            stale += 1
+            continue
+        hists = m.get("histograms", {})
+        block = hists.get("gram.block", {})
+        wait = hists.get("multihost.consensus", {})
+        stall = hists.get("prefetch.get_wait_s", {})
+        derived = m.get("derived", {})
+        phases = m.get("phases", {})
+        rows.append({
+            "rank": rank,
+            "gram_gflops": derived.get("gram_gflops_per_s", 0.0),
+            "ingest_mb_s": derived.get("ingest_mb_per_s", 0.0),
+            "block_p50_ms": block.get("p50", 0.0) * 1e3,
+            "block_p95_ms": block.get("p95", 0.0) * 1e3,
+            "stall_frac": stall_fraction(phases, stall.get("sum", 0.0)),
+            "retries": int(m.get("counters", {}).get("ingest.retries", 0)),
+            "wait_mean_ms": (wait.get("mean", 0.0)) * 1e3,
+            "wait_p95_ms": wait.get("p95", 0.0) * 1e3,
+        })
+    cols = ("rank", "gram_gflops", "ingest_mb_s", "block_p50_ms",
+            "block_p95_ms", "stall_frac", "retries", "wait_mean_ms",
+            "wait_p95_ms")
+    lines = ["\t".join(cols)]
+    for r in rows:
+        lines.append("\t".join(
+            str(r["rank"]) if c == "rank"
+            else str(r["retries"]) if c == "retries"
+            else f"{r[c]:.3f}" if c == "stall_frac"
+            else f"{r[c]:.2f}"
+            for c in cols
+        ))
+    waits = [r["wait_mean_ms"] for r in rows]
+    if len(waits) > 1:
+        lines.append(
+            f"consensus wait skew (max-min of per-rank mean): "
+            f"{max(waits) - min(waits):.2f} ms"
+        )
+    if len(rows) < n_proc:
+        note = (f"note: {n_proc - len(rows)} rank(s) had not exported "
+                "when rank 0 wrote this summary")
+        if stale:
+            note += (f" ({stale} stale file(s) from a previous run in "
+                     "this directory were ignored)")
+        lines.append(note)
+    with open(os.path.join(base, "summary.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")

@@ -15,12 +15,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator
 
 import jax
 import numpy as np
 
-from spark_examples_tpu.core import faults
+from spark_examples_tpu.core import faults, telemetry
 from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE, MISSING
 from spark_examples_tpu.ingest import bitpack
 from spark_examples_tpu.ingest.source import BlockMeta, GenotypeSource
@@ -149,10 +150,23 @@ def _produce_host_blocks(
     grid = pad_multiple * (bitpack.VARIANTS_PER_BYTE if pack else 1)
     width = -(-block_variants // grid) * grid
 
-    def _put(item) -> bool:
+    def _put(item, measure: bool = True) -> bool:
+        # Producer-side backpressure metric: time this block waited for
+        # queue space. Consistently large put-waits mean the CONSUMER
+        # (device transfer/update) is the bottleneck and deeper prefetch
+        # buys nothing; ~zero means ingest is the bottleneck (see the
+        # get-wait twin below). Sentinel puts (_END, exceptions) are
+        # NOT measured: the terminal _END put blocks until the consumer
+        # drains the whole queue, and that one non-block sample would
+        # dominate a short stream's p95/max and fake a consumer
+        # bottleneck.
+        t0 = time.perf_counter()
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
+                if measure:
+                    telemetry.observe("prefetch.put_wait_s",
+                                      time.perf_counter() - t0)
                 return True
             except queue.Full:
                 continue
@@ -184,19 +198,31 @@ def _produce_host_blocks(
                         )
                     if not _put((pad_block(block, width), meta)):
                         return
-            _put(_END)
+            _put(_END, measure=False)
         except BaseException as e:  # propagate into consumer
-            _put(e)
+            _put(e, measure=False)
 
     t = threading.Thread(target=produce, daemon=True)
     t.start()
     try:
         while True:
+            # Depth sampled before each get: max == configured depth
+            # means the producer runs ahead (healthy); persistent 0
+            # means the chip is starved by ingest. The get-wait is the
+            # stall the consumer actually paid — its sum over the gram
+            # phase is the bench digest's "prefetch stall fraction".
+            telemetry.gauge_set("prefetch.queue_depth", q.qsize())
+            t0 = time.perf_counter()
             item = q.get()
             if item is _END:
                 return
             if isinstance(item, BaseException):
                 raise item
+            # Observed only for real blocks (the sentinel's wait is not
+            # a per-block stall, and its sum feeds the digest's
+            # prefetch_stall_frac).
+            telemetry.observe("prefetch.get_wait_s",
+                              time.perf_counter() - t0)
             yield item
     finally:
         stop.set()

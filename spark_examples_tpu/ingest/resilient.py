@@ -40,7 +40,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from spark_examples_tpu.core import faults
+from spark_examples_tpu.core import faults, telemetry
 from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE
 from spark_examples_tpu.ingest.source import GenotypeSource
 
@@ -139,6 +139,7 @@ class RetryingSource:
             or block.shape[0] != n
             or block.dtype != GENOTYPE_DTYPE
         ):
+            telemetry.count("ingest.corrupt_blocks")
             raise CorruptBlockError(
                 f"corrupt block at variant cursor {cursor}: got "
                 f"shape {getattr(block, 'shape', None)} dtype "
@@ -169,6 +170,7 @@ class RetryingSource:
                 # and produce the same cursor-naming exhaustion error —
                 # not escape as a raw OSError.
                 if need_reopen and self.reopen is not None:
+                    telemetry.count("ingest.reopens")
                     self.inner = self.reopen()
                 need_reopen = False
                 it = opener(cursor)
@@ -189,6 +191,7 @@ class RetryingSource:
                 return
             except self.policy.retry_on as e:
                 if retries_left <= 0:
+                    telemetry.count("ingest.exhausted")
                     raise IngestExhaustedError(
                         f"ingest failed at variant cursor {cursor} after "
                         f"{self.policy.max_retries} retries: {e!r} — "
@@ -199,6 +202,12 @@ class RetryingSource:
                 attempt = self.policy.max_retries - retries_left
                 retries_left -= 1
                 delay = self.policy.sleep_s(attempt, rng)
+                # Counted process-wide (this source has no timer handle);
+                # PhaseTimer.report() surfaces nonzero retry counters so
+                # a silently-retrying run is distinguishable from a
+                # clean one in the same output that reports throughput.
+                telemetry.count("ingest.retries")
+                telemetry.count("ingest.backoff_s", delay)
                 warnings.warn(
                     f"transient ingest error at variant cursor {cursor} "
                     f"({e!r}); retrying in {delay * 1e3:.0f} ms "

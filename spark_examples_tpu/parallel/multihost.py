@@ -46,7 +46,7 @@ import numpy as np
 import jax
 from jax.experimental import multihost_utils
 
-from spark_examples_tpu.core import faults
+from spark_examples_tpu.core import faults, telemetry
 from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE, MISSING
 from spark_examples_tpu.ingest.prefetch import (
     PACKED_MISSING,
@@ -186,8 +186,14 @@ def stream_global_blocks(
             stats["consensus_rounds"] = stats.get("consensus_rounds", 0) + 1
         # Chaos site: a "delay" fault here is a straggling control plane
         # — the collective must absorb it, not deadlock or reorder.
+        # (Fired OUTSIDE the span: an injected local delay is this
+        # rank's own lateness, while the span measures time spent
+        # WAITING IN the collective for peers — the per-rank wait skew
+        # is the straggler metric, visible on the ranks that did NOT
+        # straggle.)
         faults.fire("multihost.consensus")
-        return allgather(value)
+        with telemetry.span("multihost.consensus", cat="multihost"):
+            return allgather(value)
 
     def assemble(item):
         slab, meta = item if item is not None else (missing_slab, None)

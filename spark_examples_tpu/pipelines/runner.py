@@ -26,7 +26,7 @@ import jax
 import numpy as np
 
 from spark_examples_tpu.core import checkpoint as ckpt
-from spark_examples_tpu.core import meshes
+from spark_examples_tpu.core import meshes, telemetry
 from spark_examples_tpu.core.config import IngestConfig, JobConfig
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.ingest import (
@@ -310,6 +310,12 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
     blocks_done = 0
     last_stop = start_variant
     with timer.phase("gram"):
+        # Per-block span: the full block PERIOD — producer/queue wait,
+        # H2D transfer, update dispatch, hooks, checkpoint — begun
+        # before each pull so the timeline shows where the wall-clock
+        # actually went (the histogram under the same name feeds the
+        # bench digest's block p50/p95).
+        sp = telemetry.begin("gram.block", cat="gram")
         for block, meta in stream_to_device(
             source, bv, start_variant, sharding=plan.block_sharding,
             pad_multiple=n_shards, pack=packed, stats=stream_stats,
@@ -334,6 +340,9 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
                     source.sample_ids, stream_stats=stream_stats,
                     plan=plan,
                 )
+            sp.end(index=blocks_done, stop=meta.stop)
+            sp = telemetry.begin("gram.block", cat="gram")
+        sp.cancel()  # the final begin only saw the stream's end
         acc = hard_sync(acc)
 
     # The stream already counted the variants (meta.stop of the final
@@ -361,6 +370,7 @@ def _finish_gram_multihost(job, source, timer, plan, update, acc,
     blocks_done = 0
     last_stop = start_variant
     with timer.phase("gram"):
+        sp = telemetry.begin("gram.block", cat="gram")
         for gblock, meta in mh.stream_global_blocks(
             source, bv, start_variant, plan, packed, stats=stream_stats,
             prefetch=job.ingest.prefetch_blocks,
@@ -395,6 +405,19 @@ def _finish_gram_multihost(job, source, timer, plan, update, acc,
                     source.sample_ids, stream_stats=stream_stats,
                     plan=plan,
                 )
+            # A consensus step where this process fed an all-MISSING
+            # padding slab is NOT a block: recording it into gram.block
+            # would drag the idle rank's p50/p95 toward zero and make
+            # the straggler comparison read the starved rank as the
+            # fast one. It gets an instant marker instead.
+            if meta is not None:
+                sp.end(index=blocks_done)
+            else:
+                sp.cancel()
+                telemetry.event("gram.pad_step", cat="gram",
+                                index=blocks_done)
+            sp = telemetry.begin("gram.block", cat="gram")
+        sp.cancel()
         acc = hard_sync(acc)
 
     # Global totals: sum of every process's partition.

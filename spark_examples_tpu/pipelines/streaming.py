@@ -30,7 +30,7 @@ from functools import lru_cache
 import jax
 import numpy as np
 
-from spark_examples_tpu.core import meshes
+from spark_examples_tpu.core import meshes, telemetry
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.ops import distances
 from spark_examples_tpu.ops.centering import gower_center
@@ -187,6 +187,12 @@ def incremental_pcoa_job(
         stop = state["last_stop"]
         state.update(q=q, b=b, b_variants=stop)
         state["snapshots"].append(StreamSnapshot(stop, vals, coords))
+        # Timeline marker only — the refresh's dispatch cost is the
+        # phase.stream_refresh span around it; its drain cost is
+        # phase.stream_drain; its honest end-to-end cost is bench
+        # config 5's streamed-with minus streamed-without.
+        telemetry.event("stream.snapshot", cat="stream",
+                        n_variants=stop, blocks_done=blocks_done)
 
     grun = R.run_gram(job, source, timer, plan=plan, on_block=on_block)
     for snap in state["snapshots"]:

@@ -1,0 +1,357 @@
+"""Telemetry layer (core/telemetry.py): histogram math vs numpy, the
+JSONL trace schema (parse / nest / monotonic), exported-throughput
+agreement with PhaseTimer, the resettable hard_sync fallback warning,
+and retry-incident surfacing — plus the 2-process per-rank export with
+a nonzero consensus-wait histogram (the straggler metric)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.core.telemetry import HIST_GROWTH, Histogram
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Telemetry is process-wide: every test starts zeroed and leaves
+    the layer unconfigured (no export dir, no event buffering)."""
+    telemetry.reset()
+    telemetry.configure(dir=None)
+    yield
+    telemetry.reset()
+    telemetry.configure(dir=None)
+
+
+def _small_job(**compute_kw):
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+
+    return JobConfig(
+        ingest=IngestConfig(source="synthetic", n_samples=24,
+                            n_variants=1024, block_variants=256, seed=1),
+        compute=ComputeConfig(metric="ibs", num_pc=3,
+                              eigh_mode="randomized", **compute_kw),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Histogram: log-bucket percentiles against numpy on known samples.
+
+
+@pytest.mark.parametrize("dist", ["lognormal", "uniform", "exponential"])
+def test_histogram_percentiles_match_numpy(dist):
+    rng = np.random.default_rng(7)
+    samples = {
+        "lognormal": rng.lognormal(-5.0, 1.5, 5000),  # ~block times
+        "uniform": rng.uniform(1e-4, 2e-1, 5000),
+        "exponential": rng.exponential(3e-3, 5000),
+    }[dist]
+    h = Histogram()
+    for s in samples:
+        h.record(float(s))
+    # Bucket geometry bounds the error: the quantile is read off the
+    # geometric bucket midpoint, within sqrt(GROWTH)-1 (~4.4%) of the
+    # true value; 6% leaves room for numpy's interpolation.
+    tol = max(HIST_GROWTH ** 0.5 - 1.0, 0.044) + 0.016
+    for q in (50, 95, 99):
+        want = float(np.percentile(samples, q))
+        got = h.quantile(q / 100.0)
+        assert abs(got - want) / want < tol, (dist, q, got, want)
+    assert h.count == len(samples)
+    np.testing.assert_allclose(h.sum, samples.sum(), rtol=1e-9)
+
+
+def test_histogram_exact_edges():
+    h = Histogram()
+    assert h.quantile(0.5) == 0.0  # empty
+    h.record(0.123)
+    # Single sample: min/max clamping makes every quantile exact.
+    assert h.quantile(0.5) == pytest.approx(0.123)
+    assert h.quantile(0.99) == pytest.approx(0.123)
+    h2 = Histogram()
+    for _ in range(100):
+        h2.record(4.2e-3)
+    assert h2.quantile(0.95) == pytest.approx(4.2e-3)
+    h2.record(-1.0)  # nonpositive -> underflow bucket, no crash
+    assert h2.min == -1.0
+
+
+def test_counters_gauges_reset():
+    assert telemetry.count("ingest.retries") == 1.0
+    assert telemetry.count("ingest.retries", 2.0) == 3.0
+    assert telemetry.counter_value("ingest.retries") == 3.0
+    telemetry.gauge_set("prefetch.queue_depth", 2)
+    telemetry.gauge_set("prefetch.queue_depth", 0)
+    snap = telemetry.metrics_snapshot()
+    g = snap["gauges"]["prefetch.queue_depth"]
+    assert (g["last"], g["min"], g["max"], g["n"]) == (0.0, 0.0, 2.0, 2)
+    telemetry.reset()
+    assert telemetry.counter_value("ingest.retries") == 0.0
+    assert "prefetch.queue_depth" not in telemetry.metrics_snapshot()["gauges"]
+
+
+def test_unknown_name_warns_once_and_counts():
+    with pytest.warns(RuntimeWarning, match="not declared"):
+        telemetry.count("no.such.metric")
+    # Second use: counted, no second warning.
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        telemetry.count("no.such.metric")
+    assert telemetry.counter_value("telemetry.unknown_names") == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Trace JSONL schema round-trip on a real (tiny) job.
+
+
+def _run_traced_job(tmp_path, **compute_kw):
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+
+    telemetry.configure(dir=str(tmp_path / "tel"), trace_events=True)
+    out = pcoa_job(_small_job(**compute_kw))
+    d = telemetry.export()
+    return out, d
+
+
+def test_trace_jsonl_round_trip(tmp_path):
+    out, d = _run_traced_job(tmp_path)
+    lines = open(os.path.join(d, "trace.jsonl")).read().splitlines()
+    events = [json.loads(line) for line in lines]  # every line parses
+    spans = [e for e in events if e["ph"] == "X"]
+    assert spans, "no span events recorded"
+    for e in spans:
+        assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid",
+                          "args"}, e
+        assert e["pid"] == 0
+        assert e["dur"] >= 0
+    names = {e["name"] for e in spans}
+    assert "gram.block" in names
+    assert "phase.gram" in names and "phase.eigh" in names
+    # The per-block spans carry their attrs.
+    blocks = [e for e in spans if e["name"] == "gram.block"]
+    assert len(blocks) == 4  # 1024 variants / 256 per block
+    assert [b["args"]["index"] for b in blocks] == [1, 2, 3, 4]
+
+    # Monotonic ordering per rank: the exporter sorts by ts.
+    ts = [e["ts"] for e in events if e["ph"] in ("X", "i")]
+    assert all(a <= b for a, b in zip(ts, ts[1:]))
+
+    # Spans nest: per tid, intervals are properly contained or disjoint
+    # (strict LIFO context managers can't produce partial overlap).
+    EPS = 0.5  # microseconds of float slack
+    by_tid: dict = {}
+    for e in spans:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: list[float] = []
+        for e in evs:
+            while stack and e["ts"] >= stack[-1] - EPS:
+                stack.pop()
+            if stack:
+                assert e["ts"] + e["dur"] <= stack[-1] + EPS, (
+                    "partial overlap within a thread", e)
+            stack.append(e["ts"] + e["dur"])
+
+
+def test_metrics_json_agrees_with_phase_timer(tmp_path):
+    out, d = _run_traced_job(tmp_path)
+    m = json.load(open(os.path.join(d, "metrics.json")))
+    rep = out.timer.report()
+    for key in ("gram_gflops_per_s", "ingest_mb_per_s", "eigh_gflops_per_s"):
+        assert key in m["derived"], (key, m["derived"])
+        assert m["derived"][key] == pytest.approx(rep[key], rel=0.01)
+    # Registry subsumes PhaseTimer.counters.
+    for cname, value in out.timer.counters.items():
+        assert m["counters"][cname] == pytest.approx(value)
+    # Prefetch instrumentation fired.
+    assert m["histograms"]["prefetch.get_wait_s"]["count"] >= 4
+    assert m["gauges"]["prefetch.queue_depth"]["n"] >= 4
+    # rank-0 summary table exists and names the rank.
+    summary = open(os.path.join(os.path.dirname(d), "summary.txt")).read()
+    assert "gram_gflops" in summary and "\n0\t" in summary
+
+
+def test_no_trace_events_mode_keeps_metrics(tmp_path):
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+
+    telemetry.configure(dir=str(tmp_path / "tel"), trace_events=False)
+    pcoa_job(_small_job())
+    d = telemetry.export()
+    events = [json.loads(line)
+              for line in open(os.path.join(d, "trace.jsonl"))]
+    assert all(e["ph"] == "M" for e in events)  # metadata only
+    m = json.load(open(os.path.join(d, "metrics.json")))
+    assert m["histograms"]["gram.block"]["count"] == 4  # spans still measured
+    assert "gram_gflops_per_s" in m["derived"]
+
+
+def test_digest_shape():
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+
+    pcoa_job(_small_job())
+    dig = telemetry.digest()
+    assert dig["blocks"] == 4
+    assert dig["block_p95_s"] >= dig["block_p50_s"] > 0
+    assert 0.0 <= dig["prefetch_stall_frac"] <= 1.0
+    assert dig["ingest_retries"] == 0
+    assert dig["consensus_wait_p95_s"] == 0.0  # single process
+
+
+# ---------------------------------------------------------------------------
+# Satellite: hard_sync per-shard fallback — counter + resettable
+# warn-once (the old module-global latch was untestable and invisible
+# after the first warning).
+
+
+def test_hard_sync_fallback_counts_and_rearms(monkeypatch):
+    import jax
+
+    from spark_examples_tpu.core import profiling
+
+    def boom(leaf):
+        raise RuntimeError("injected checksum failure")
+
+    monkeypatch.setattr(profiling, "_leaf_sum", boom)
+    x = jax.numpy.arange(8.0)
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        profiling.hard_sync(x)
+    assert telemetry.counter_value("hard_sync.fallback") == 1.0
+    # Second occurrence: counted, NOT re-warned.
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        profiling.hard_sync(x)
+    assert telemetry.counter_value("hard_sync.fallback") == 2.0
+    # reset() re-arms the warning — the latch is now testable state.
+    telemetry.reset()
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        profiling.hard_sync(x)
+    assert telemetry.counter_value("hard_sync.fallback") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Satellite: retry incidents surface in run output.
+
+
+def test_retry_incidents_surface_in_timer_report(tmp_path):
+    from spark_examples_tpu.core.profiling import PhaseTimer
+    from spark_examples_tpu.ingest.packed import load_packed, pack_source
+    from spark_examples_tpu.ingest.resilient import RetryingSource, RetryPolicy
+    from spark_examples_tpu.ingest.synthetic import SyntheticSource
+
+    store = str(tmp_path / "store")
+    pack_source(store, SyntheticSource(n_samples=8, n_variants=256, seed=3),
+                64)
+    src = RetryingSource(
+        load_packed(store),
+        policy=RetryPolicy(max_retries=3, backoff_s=0.001),
+        reopen=lambda: load_packed(store),
+    )
+    timer = PhaseTimer()
+    with faults.armed(["ingest.block_read:io_error:after=1:max=2"]):
+        with timer.phase("gram"):
+            blocks = [b for b, _ in src.blocks(64)]
+    assert len(blocks) == 4  # stream completed despite the faults
+    assert telemetry.counter_value("ingest.retries") == 2.0
+    assert telemetry.counter_value("ingest.reopens") == 2.0
+    assert telemetry.counter_value("faults.fired") == 2.0
+    rep = timer.report()
+    # The silently-retrying run is distinguishable from a clean one.
+    assert rep["ingest_retries"] == 2.0
+    assert rep["ingest_reopens"] == 2.0
+    assert "ingest_corrupt_blocks" not in rep  # zero stays silent
+
+    # A timer constructed AFTER those incidents must not inherit them:
+    # incidents are reported as deltas against the construction-time
+    # snapshot, not as process-lifetime totals.
+    fresh = PhaseTimer()
+    with fresh.phase("gram"):
+        list(src.blocks(64))
+    assert "ingest_retries" not in fresh.report()
+
+    telemetry.reset()
+    with timer.phase("gram"):
+        list(src.blocks(64))
+    assert "ingest_retries" not in timer.report()  # clean run, clean report
+
+
+# ---------------------------------------------------------------------------
+# 2-process: one file set per rank, nonzero consensus-wait histogram.
+
+
+_TELEMETRY_WORKER = r"""
+import json, os
+import numpy as np
+
+from spark_examples_tpu.core.virtual import force_virtual_cpu
+force_virtual_cpu(2)
+
+import jax
+
+from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core.config import (
+    ComputeConfig, IngestConfig, JobConfig,
+)
+from spark_examples_tpu.pipelines.jobs import pcoa_job
+from spark_examples_tpu.pipelines.runner import build_source
+
+telemetry.configure(dir=os.environ["TDIR"], trace_events=True)
+job = JobConfig(
+    ingest=IngestConfig(source="synthetic", n_samples=24, n_variants=1280,
+                        block_variants=256, seed=5),
+    compute=ComputeConfig(gram_mode="variant", eigh_mode="randomized",
+                          num_pc=3, metric="ibs"),
+)
+src = build_source(job.ingest)
+assert jax.process_count() == 2
+out = pcoa_job(job, source=src)
+d = telemetry.export()
+m = json.load(open(os.path.join(d, "metrics.json")))
+wait = m["histograms"].get("multihost.consensus", {"count": 0})
+print(json.dumps({
+    "process": jax.process_index(),
+    "dir": d,
+    "consensus_count": wait.get("count", 0),
+    "consensus_sum": wait.get("sum", 0.0),
+    "blocks": m["histograms"]["gram.block"]["count"],
+}))
+"""
+
+
+def test_two_process_per_rank_export_and_consensus_wait(tmp_path):
+    from test_distributed import _run_two_process
+
+    tdir = str(tmp_path / "tel")
+    outs = _run_two_process(_TELEMETRY_WORKER, extra_env={"TDIR": tdir})
+    assert {o["process"] for o in outs} == {0, 1}
+    for o in outs:
+        rank_dir = os.path.join(tdir, f"rank{o['process']}")
+        assert o["dir"] == rank_dir
+        # One file set per rank.
+        assert os.path.exists(os.path.join(rank_dir, "trace.jsonl"))
+        assert os.path.exists(os.path.join(rank_dir, "metrics.json"))
+        # The consensus-wait histogram is nonzero: at least the upfront
+        # step-count round and the terminal contract round.
+        assert o["consensus_count"] >= 2, o
+        assert o["consensus_sum"] > 0.0, o
+    # 1280 variants / 256 -> 3 consensus steps; the rank with the
+    # 512-variant share streams 2 REAL blocks and pads its 3rd step —
+    # padding must NOT count as a gram.block sample.
+    assert sorted(o["blocks"] for o in outs) == [2, 3], outs
+    for o in outs:
+        rank_dir = os.path.join(tdir, f"rank{o['process']}")
+        events = [json.loads(line)
+                  for line in open(os.path.join(rank_dir, "trace.jsonl"))]
+        span_names = {e["name"] for e in events if e["ph"] == "X"}
+        assert "multihost.consensus" in span_names
+        assert all(e["pid"] == o["process"] for e in events)
+    # rank 0 wrote the merged summary (best-effort peer merge).
+    assert os.path.exists(os.path.join(tdir, "summary.txt"))

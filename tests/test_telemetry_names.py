@@ -1,0 +1,87 @@
+"""Names lint (tier-1): every span/counter/gauge/histogram/event name
+used at a telemetry call site in the codebase must be declared in the
+canonical registry (core/telemetry.py NAMES) — a typo'd metric name
+would otherwise silently fork a timeline into two series nobody ever
+joins back together."""
+
+import pathlib
+import re
+
+from spark_examples_tpu.core import telemetry
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# Literal-name call sites: telemetry.<api>("name", ...). Dynamic names
+# (e.g. PhaseTimer's "phase." + name) are covered at runtime by the
+# registry's warn-and-count check instead — this lint is the static
+# half of the same contract.
+_CALL = re.compile(
+    r"\btelemetry\.(?:count|observe|gauge_set|event|begin|span|traced"
+    r"|counter_value)\(\s*([fr]?)([\"'])([^\"']+)\2"
+)
+
+
+def _source_files():
+    yield from (REPO / "spark_examples_tpu").rglob("*.py")
+    yield REPO / "bench.py"
+
+
+def test_every_used_name_is_declared():
+    undeclared = []
+    fstring_sites = []
+    for path in _source_files():
+        text = path.read_text()
+        for m in _CALL.finditer(text):
+            prefix, _, name = m.groups()
+            line = text[: m.start()].count("\n") + 1
+            if "f" in prefix:
+                # An f-string name can't be statically checked — the
+                # registry's families + runtime check exist for dynamic
+                # names; literal sites must stay literal.
+                fstring_sites.append(f"{path.name}:{line}: f-string name")
+                continue
+            if not telemetry.is_declared(name):
+                undeclared.append(f"{path.name}:{line}: {name!r}")
+    assert not undeclared, (
+        "telemetry names used but not declared in telemetry.NAMES "
+        "(add them to the canonical registry): " + "; ".join(undeclared)
+    )
+    assert not fstring_sites, (
+        "telemetry call sites must pass literal names (use attrs for "
+        "the dynamic part): " + "; ".join(fstring_sites)
+    )
+
+
+def test_registry_is_well_formed():
+    assert telemetry.NAMES, "registry emptied"
+    for name, entry in telemetry.NAMES.items():
+        kind, desc = entry
+        assert kind in telemetry.KINDS, (name, kind)
+        assert isinstance(desc, str) and len(desc) > 10, (
+            f"{name}: a registry entry without a real description is a "
+            "glossary hole")
+        assert re.fullmatch(r"[a-z0-9_.]+(\.\*)?", name), name
+        if name.endswith(".*"):
+            assert len(name) > 2, name
+
+
+def test_core_names_present():
+    # The instrumentation contract of this PR — removing one of these
+    # silently un-instruments a subsystem.
+    for name in (
+        "gram.block",
+        "multihost.consensus",
+        "prefetch.queue_depth",
+        "prefetch.put_wait_s",
+        "prefetch.get_wait_s",
+        "ingest.retries",
+        "checkpoint.save",
+        "checkpoint.fallback",
+        "faults.fired",
+        "hard_sync.fallback",
+        "stream.snapshot",
+        "phase.*",
+    ):
+        assert name in telemetry.NAMES, name
+    assert telemetry.is_declared("phase.gram")  # family resolution
+    assert not telemetry.is_declared("phasegram")
