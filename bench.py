@@ -746,6 +746,96 @@ def bench_streaming(store: str) -> dict:
     }
 
 
+def bench_serve(store: str) -> dict:
+    """``--serve``: the online projection server's first bench numbers.
+
+    A 2504-sample x 128k-variant prefix of the config-1 cohort is the
+    reference panel: fit (and cache) a PCoA model on it, stage it
+    device-resident through the serving engine, then drive the server
+    with concurrent closed-loop clients. Reported: offered vs sustained
+    QPS, latency p50/p99 (read from the telemetry registry — the same
+    numbers --telemetry-dir exports), micro-batch occupancy, and a
+    bit-identity check of one served query against the offline
+    ``project`` path on the same inputs (the serving contract)."""
+    from spark_examples_tpu.core import telemetry
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+    from spark_examples_tpu.pipelines.project import pcoa_project_job
+    from spark_examples_tpu.serve import (
+        ProjectionEngine, ProjectionServer, run_loadgen,
+    )
+
+    nv = 131_072
+    model_path = os.path.join(CACHE, f"serve_model_{N_SAMPLES}x{nv}.npz")
+    job = JobConfig(
+        ingest=IngestConfig(source="packed", path=store,
+                            block_variants=BLOCK),
+        compute=ComputeConfig(metric=METRIC, num_pc=K),
+        model_path=model_path,
+    )
+    if not os.path.exists(model_path):
+        log(f"fitting serve panel model ({N_SAMPLES} x {nv}, cached)...")
+        pcoa_job(job, source=_slice_store(store, nv))
+
+    t0 = time.perf_counter()
+    engine = ProjectionEngine(model_path, _slice_store(store, nv),
+                              block_variants=BLOCK, max_batch=8)
+    startup_s = time.perf_counter() - t0  # stage + warm (the cold start
+    # every offline projection pays and every served request does not)
+
+    # Pool size >= total loadgen requests (8 clients x 32), plus one
+    # extra row reserved for the bit-identity probe: every loadgen
+    # request is then a distinct never-cached query, so the reported
+    # QPS/latency measure the DEVICE serving path, not the result cache
+    # (loadgen docstring: a pool smaller than the cache turns the run
+    # into a cache bench).
+    n_queries = 8 * 32 + 1
+    queries = np.where(
+        np.random.default_rng(5).random((n_queries, nv)) < 0.01, -1,
+        np.random.default_rng(6).integers(0, 3, (n_queries, nv)),
+    ).astype(np.int8)
+
+    server = ProjectionServer(engine, max_linger_s=0.002, max_queue=64,
+                              cache_entries=256).start()
+    try:
+        served = server.project(queries[-1], timeout=120.0)
+        offline = pcoa_project_job(
+            job.replace(model_path=None, output_path=None),
+            model_path=model_path,
+            source_new=ArraySource(queries[-1:]),
+            source_ref=_slice_store(store, nv),
+        ).coords
+        identical = bool(np.array_equal(served, offline))
+        # Fresh registry for the timed run: the identity probe's single
+        # (and now cached) request must not sit in the latency histogram
+        # the report's p50/p99 are read from.
+        telemetry.reset()
+        report = run_loadgen(server, queries[:-1], clients=8,
+                             requests_per_client=32,
+                             result_timeout_s=300.0)
+    finally:
+        clean = server.drain()
+        server.close()
+    rows = telemetry.metrics_snapshot()["histograms"].get(
+        "serve.batch_rows", {})
+    log(f"serve: sustained {report['sustained_qps']} QPS "
+        f"(offered {report['offered_qps']}), p50 "
+        f"{report['latency_p50_ms']} ms / p99 "
+        f"{report['latency_p99_ms']} ms, batch rows mean "
+        f"{rows.get('mean', 0.0):.2f}, bit-identical={identical}")
+    return {
+        "panel": [N_SAMPLES, nv],
+        "startup_stage_warm_s": round(startup_s, 2),
+        "bit_identical_vs_offline": identical,
+        "clean_drain": clean,
+        "batch_rows_mean": round(rows.get("mean", 0.0), 2),
+        **{k: v for k, v in report.items() if k != "server"},
+    }
+
+
 def chaos_streamed(store: str, want_coords: np.ndarray) -> dict:
     """The config-1 streamed pipeline re-run with faults armed at every
     site the job path crosses: the retry layer absorbs injected
@@ -916,6 +1006,13 @@ def main() -> None:
             log(f"chaos FAILED: {e!r}")
             configs["chaos"] = {"error": repr(e)}
 
+    if "--serve" in sys.argv:
+        try:
+            configs["serve"] = bench_serve(store)
+        except Exception as e:
+            log(f"serve FAILED: {e!r}")
+            configs["serve"] = {"error": repr(e)}
+
     # Every TPU path whose time is reported must also recover the planted
     # structure — a fast wrong answer must not print a speedup.
     checks = [
@@ -964,6 +1061,13 @@ def main() -> None:
     if "chaos" in configs:
         headline["chaos_ok"] = configs["chaos"].get(
             "coords_bit_identical", False
+        )
+    if "serve" in configs and "error" not in configs["serve"]:
+        headline["serve_sustained_qps"] = configs["serve"]["sustained_qps"]
+        headline["serve_p99_ms"] = configs["serve"]["latency_p99_ms"]
+        headline["serve_ok"] = bool(
+            configs["serve"]["bit_identical_vs_offline"]
+            and configs["serve"]["clean_drain"]
         )
     full = {**headline, "configs": configs}
     try:

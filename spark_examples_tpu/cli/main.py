@@ -263,6 +263,55 @@ def main(argv: list[str] | None = None) -> int:
                         "model was fitted on)")
     p_proj.add_argument("--ref-path", default=None)
 
+    p_srv = sub.add_parser(
+        "serve",
+        help="long-lived online projection server: model + reference "
+        "panel staged device-resident once, queries answered through "
+        "an async micro-batching queue (bit-identical to the offline "
+        "`project` CLI); default mode binds a local HTTP endpoint, "
+        "--loadgen N instead drives it with N closed-loop clients and "
+        "prints the serving report",
+    )
+    _add_common(p_srv)  # --source/--path describe the LOADGEN query pool
+    p_srv.add_argument("--model", required=True,
+                       help=".npz from pcoa/pca --save-model")
+    p_srv.add_argument("--ref-source", default="packed",
+                       choices=["synthetic", "vcf", "packed", "plink",
+                                "parquet"],
+                       help="reference panel genotypes (the panel the "
+                       "model was fitted on) — staged to device once")
+    p_srv.add_argument("--ref-path", default=None)
+    p_srv.add_argument("--max-batch", type=int,
+                       default=config.ServeConfig.max_batch,
+                       help="micro-batch ceiling; batches are padded to "
+                       "this so one compiled program serves every size")
+    p_srv.add_argument("--max-linger-ms", type=float,
+                       default=config.ServeConfig.max_linger_ms,
+                       help="max wait past the first queued query while "
+                       "coalescing a batch (the latency/throughput dial)")
+    p_srv.add_argument("--max-queue", type=int,
+                       default=config.ServeConfig.max_queue,
+                       help="bounded admission queue; a full queue sheds "
+                       "with an explicit ServerOverloaded (HTTP 429)")
+    p_srv.add_argument("--cache-entries", type=int,
+                       default=config.ServeConfig.cache_entries,
+                       help="LRU result cache size, keyed by genotype "
+                       "digest (0 disables)")
+    p_srv.add_argument("--deadline-ms", type=float,
+                       default=config.ServeConfig.deadline_ms,
+                       help="default per-request deadline (0 = none); "
+                       "expired requests answer DeadlineExceeded/504")
+    p_srv.add_argument("--host", default=config.ServeConfig.host)
+    p_srv.add_argument("--port", type=int, default=config.ServeConfig.port,
+                       help="HTTP bind port (0 = ephemeral)")
+    p_srv.add_argument("--loadgen", type=int, default=0, metavar="CLIENTS",
+                       help="instead of serving HTTP, drive the server "
+                       "with this many concurrent closed-loop clients "
+                       "(queries from --source/--path) and print the "
+                       "offered/sustained QPS + latency report as JSON")
+    p_srv.add_argument("--loadgen-requests", type=int, default=50,
+                       help="requests per loadgen client")
+
     p_ck = sub.add_parser(
         "cross-kinship",
         help="KING-robust kinship BETWEEN two cohorts (same variant "
@@ -542,6 +591,8 @@ def _dispatch(args, parser, job, J, build_source) -> int:
         )
         _print_coords(out, job)
         timer = out.timer
+    elif args.command == "serve":
+        return _run_serve(args, parser, job, build_source)
     elif args.command == "pack":
         import time as _time
 
@@ -565,6 +616,108 @@ def _dispatch(args, parser, job, J, build_source) -> int:
 
     if args.timings:
         print(json.dumps(timer.report(), sort_keys=True), file=sys.stderr)
+    return 0
+
+
+def _run_serve(args, parser, job, build_source) -> int:
+    """The `serve` subcommand: engine + server up, then either a local
+    HTTP endpoint (default; Ctrl-C drains) or an in-process closed-loop
+    loadgen run whose JSON report goes to stdout. Telemetry export (the
+    --telemetry-dir exit-stack callback in main) fires after the drain,
+    so the exported serve.* histograms cover the whole serving life."""
+    import dataclasses as _dc
+
+    from spark_examples_tpu.serve import (
+        ProjectionEngine, ProjectionServer, run_loadgen,
+    )
+
+    if not args.ref_path and args.ref_source != "synthetic":
+        parser.error("serve requires --ref-path (the panel genotypes "
+                     "the model was fitted on)")
+    cfg = config.ServeConfig(
+        model_path=args.model,
+        max_batch=args.max_batch,
+        max_linger_ms=args.max_linger_ms,
+        max_queue=args.max_queue,
+        cache_entries=args.cache_entries,
+        deadline_ms=args.deadline_ms,
+        host=args.host,
+        port=args.port,
+    )
+    ref_cfg = _dc.replace(job.ingest, source=args.ref_source,
+                          path=args.ref_path)
+    engine = ProjectionEngine(
+        cfg.model_path, build_source(ref_cfg),
+        block_variants=job.ingest.block_variants,
+        max_batch=cfg.max_batch,
+    )
+    server = ProjectionServer(
+        engine,
+        max_linger_s=cfg.max_linger_ms / 1e3,
+        max_queue=cfg.max_queue,
+        cache_entries=cfg.cache_entries,
+        default_deadline_s=(cfg.deadline_ms / 1e3) or None,
+    )
+    server.start()
+    try:
+        if args.loadgen > 0:
+            q_cfg = job.ingest
+            if q_cfg.source == "synthetic":
+                # The pool must carry the panel's variant set; for the
+                # synthetic source that is a config knob, so align it.
+                q_cfg = _dc.replace(q_cfg, n_variants=engine.n_variants)
+            q_src = build_source(q_cfg)
+            pool = np.concatenate(
+                [b for b, _ in q_src.blocks(q_cfg.block_variants)],
+                axis=1,
+            )
+            if pool.shape[1] != engine.n_variants:
+                parser.error(
+                    f"loadgen query pool carries {pool.shape[1]} "
+                    f"variants but the model's panel has "
+                    f"{engine.n_variants} — both cohorts must be "
+                    "genotyped at the panel's sites"
+                )
+            report = run_loadgen(
+                server, pool, clients=args.loadgen,
+                requests_per_client=args.loadgen_requests,
+                deadline_s=(cfg.deadline_ms / 1e3) or None,
+            )
+            print(json.dumps(report, sort_keys=True))
+        else:
+            import signal
+
+            from spark_examples_tpu.serve.http import ProjectionHTTPServer
+
+            http = ProjectionHTTPServer(server, host=cfg.host,
+                                        port=cfg.port)
+
+            # SIGTERM (the orchestrator's stop signal — and the only
+            # deliverable one when SIGINT was inherited ignored) must
+            # drain, not kill: route it through the KeyboardInterrupt
+            # path so admitted requests are answered before exit.
+            def _sigterm(signum, frame):
+                raise KeyboardInterrupt
+
+            try:
+                signal.signal(signal.SIGTERM, _sigterm)
+            except ValueError:
+                pass  # not the main thread (embedded use) — skip
+            print(
+                f"serving projections on http://{http.host}:{http.port} "
+                f"(POST /project, GET /healthz, GET /stats; "
+                f"{engine.n_variants} variants x "
+                f"{engine.n_components} components; Ctrl-C drains)",
+                file=sys.stderr,
+            )
+            try:
+                http.serve_forever()
+            except KeyboardInterrupt:
+                print("draining...", file=sys.stderr)
+            finally:
+                http.shutdown()
+    finally:
+        server.close()
     return 0
 
 

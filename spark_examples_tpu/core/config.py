@@ -173,6 +173,31 @@ class TelemetryConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Online projection server knobs (serve/ — the ``serve`` CLI).
+
+    ``max_batch`` x ``max_linger_ms`` is the latency/throughput dial:
+    the batching worker coalesces up to max_batch queued queries but
+    never waits longer than the linger past the first one, and the
+    batch is padded to max_batch so one compiled program serves every
+    size. ``max_queue`` bounds admission — a full queue sheds with an
+    explicit ServerOverloaded instead of unbounded latency.
+    ``deadline_ms`` (0 = none) is the default per-request deadline;
+    ``cache_entries`` (0 = off) sizes the LRU result cache keyed by
+    genotype digest.
+    """
+
+    model_path: str | None = None
+    max_batch: int = 8
+    max_linger_ms: float = 2.0
+    max_queue: int = 64
+    cache_entries: int = 256
+    deadline_ms: float = 0.0
+    host: str = "127.0.0.1"
+    port: int = 8777
+
+
+@dataclass
 class JobConfig:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     compute: ComputeConfig = field(default_factory=ComputeConfig)

@@ -37,6 +37,12 @@ Sites instrumented in production code:
                             (parallel/multihost.py)
 ``device.put``              per host->device block transfer
                             (ingest/prefetch.py)
+``serve.request``           per admitted request, in the projection
+                            server's batch-assembly sweep (serve/
+                            server.py) — ``io_error`` fails exactly that
+                            request, ``delay`` stalls the worker so the
+                            bounded admission queue must shed, ``kill``
+                            simulates a serving-process preemption
 ==========================  ====================================================
 
 Env grammar (``;``-separated specs, ``:``-separated fields)::
@@ -73,6 +79,7 @@ SITES = (
     "checkpoint.tile_read",
     "multihost.consensus",
     "device.put",
+    "serve.request",
 )
 
 # Distinctive exit code for the "kill" kind so tests can tell an injected

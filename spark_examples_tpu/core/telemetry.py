@@ -83,6 +83,23 @@ NAMES: dict[str, tuple[str, str]] = {
     "checkpoint.verify": ("span", "sha256 re-hash of this rank's files on load"),
     "checkpoint.rotate": ("span", "atomic generation rotation on the primary"),
     "checkpoint.load": ("span", "one checkpoint load (verify + agree + place)"),
+    "serve.assemble": (
+        "span",
+        "one micro-batch assembly in the projection server: dequeue sweep "
+        "(fault site, cancellation, deadline expiry) + stack/pad of the "
+        "live queries",
+    ),
+    "serve.device_step": (
+        "span",
+        "one padded micro-batch through the device: cross-stat "
+        "accumulation against the staged reference blocks + per-row "
+        "finalize (the compiled hot path one jit entry serves)",
+    ),
+    "serve.drain": (
+        "span",
+        "graceful server drain: admission closed, wall-clock until every "
+        "in-flight request resolved and the worker joined",
+    ),
     # -- instant events ---------------------------------------------------
     "fault": ("event", "a fault-injection spec fired (args: site, kind)"),
     "stream.snapshot": (
@@ -123,12 +140,47 @@ NAMES: dict[str, tuple[str, str]] = {
     ),
     "telemetry.dropped_events": ("counter", "trace events dropped past MAX_EVENTS"),
     "telemetry.unknown_names": ("counter", "instrumentation calls with undeclared names"),
+    "serve.requests": (
+        "counter",
+        "requests admitted into the projection server's bounded queue "
+        "(cache hits answered at submit are counted separately)",
+    ),
+    "serve.shed": (
+        "counter",
+        "requests rejected with ServerOverloaded at admission — the "
+        "bounded queue was full (explicit load-shedding, not latency)",
+    ),
+    "serve.cache_hits": (
+        "counter",
+        "requests answered from the LRU result cache by genotype digest "
+        "(no queue, no device work)",
+    ),
+    "serve.cache_misses": ("counter", "requests that missed the result cache"),
+    "serve.deadline_expired": (
+        "counter",
+        "admitted requests dropped at batch assembly because their "
+        "deadline had already passed (answered with DeadlineExceeded)",
+    ),
+    "serve.cancelled": (
+        "counter",
+        "admitted requests cancelled by the client before batch pickup",
+    ),
+    "serve.errors": (
+        "counter",
+        "admitted requests answered with a processing error (including "
+        "injected serve.request faults)",
+    ),
     # -- gauges -----------------------------------------------------------
     "prefetch.queue_depth": (
         "gauge",
         "prefetch queue occupancy sampled at each consumer get (max == "
         "configured depth means the producer is ahead; 0 means the chip "
         "is starved)",
+    ),
+    "serve.in_flight": (
+        "gauge",
+        "admitted-but-unanswered requests in the projection server "
+        "(queued + in the current batch); max is the realized backlog",
     ),
     # -- histograms -------------------------------------------------------
     "prefetch.put_wait_s": (
@@ -140,6 +192,23 @@ NAMES: dict[str, tuple[str, str]] = {
         "histogram",
         "consumer wait per block for the producer (large => ingest is the "
         "bottleneck; sum/gram time = the stall fraction)",
+    ),
+    "serve.enqueue_wait_s": (
+        "histogram",
+        "per admitted request: wall-clock from admission to batch pickup "
+        "(large => the device step or linger window is the bottleneck)",
+    ),
+    "serve.latency_s": (
+        "histogram",
+        "per served request: submit to completed result, cache hits "
+        "included — the client-visible latency whose p50/p99 the loadgen "
+        "reports",
+    ),
+    "serve.batch_rows": (
+        "histogram",
+        "live (non-padding) queries per executed micro-batch: mean near "
+        "max_batch means coalescing is working; 1 means linger is too "
+        "short for the offered load",
     ),
 }
 

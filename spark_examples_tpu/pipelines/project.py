@@ -27,12 +27,22 @@ extension above) and the flagship PCA over shared-alt similarities
 (``pca --save-model``; a new row's cross similarity is centered with
 the reference's column/grand means and projected onto V — training
 rows reproduce their fitted coordinates exactly, since C V = V Λ).
+
+The long-lived ONLINE counterpart of this module is
+``spark_examples_tpu/serve/``: the serving engine stages the panel
+device-resident and reuses this module's jitted cross-update and
+finalize programs (and :func:`load_model` / :func:`clear_caches`),
+which is what makes served coordinates bit-identical to this offline
+path.
 """
 
 from __future__ import annotations
 
+import hashlib
+import zipfile
+from collections import OrderedDict
 from dataclasses import dataclass
-from functools import lru_cache, partial
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +67,173 @@ PROJECTABLE = {
     ("pca", "shared-alt"): ("s",),
 }
 
+# Saved-model schema version. Bump when a field is added/renamed/
+# re-semanticized; load_model refuses files it cannot interpret with a
+# friendly error instead of a raw KeyError — the serving layer hot-
+# reloads models, and "which field is missing/old" must be diagnosable
+# from the exception alone. Version 2 = the first versioned schema
+# (version 1, retroactively, is the unversioned pre-serving format).
+SCHEMA_VERSION = 2
+
+# Required archive members per model kind (beyond schema_version itself).
+_MODEL_KEYS = {
+    "pcoa": ("kind", "metric", "eigvecs", "eigvals", "d2_colmean",
+             "d2_grand", "sample_ids"),
+    "pca": ("kind", "metric", "eigvecs", "eigvals", "s_colmean",
+            "s_grand", "sample_ids"),
+}
+
+
+class ModelFormatError(ValueError):
+    """A saved-model .npz that cannot be safely interpreted: truncated/
+    corrupt archive, pre-versioning file, future schema, or a missing
+    required field — always with the offending field/cause named."""
+
+
+@dataclass(frozen=True)
+class ProjectionModel:
+    """A loaded, validated saved model — everything projection needs.
+
+    ``colmean``/``grand`` are the kind-appropriate centering statistics
+    (reference D^2 column/grand means for PCoA, similarity column/grand
+    means for PCA); arrays are float64 exactly as persisted (consumers
+    cast to f32 at the device boundary, matching the offline path).
+    """
+
+    kind: str
+    metric: str
+    eigvecs: np.ndarray
+    eigvals: np.ndarray
+    colmean: np.ndarray
+    grand: float
+    sample_ids: list[str]
+    schema_version: int = SCHEMA_VERSION
+
+    @property
+    def n_ref(self) -> int:
+        return int(self.eigvecs.shape[0])
+
+    @property
+    def n_components(self) -> int:
+        return int(self.eigvecs.shape[1])
+
+    def digest(self) -> str:
+        """Content fingerprint — namespaces the serving result cache so
+        a hot-reloaded model can never serve a stale cached result."""
+        h = hashlib.sha256()
+        h.update(
+            f"{self.kind}:{self.metric}:{self.schema_version}".encode()
+        )
+        for a in (self.eigvecs, self.eigvals, self.colmean):
+            h.update(np.ascontiguousarray(a).tobytes())
+        h.update(np.float64(self.grand).tobytes())
+        return h.hexdigest()[:16]
+
+
+def load_model(path: str) -> ProjectionModel:
+    """Load + validate a saved model, friendly-erroring on bad files.
+
+    Every failure mode a long-lived server can hit on reload gets a
+    :class:`ModelFormatError` naming the cause: unreadable/truncated
+    archive, a pre-versioning model (no ``schema_version``), a model
+    from a NEWER build, an unknown ``kind``, or a missing required
+    field. A raw ``KeyError``/``BadZipFile`` never escapes."""
+    try:
+        npz = np.load(path, allow_pickle=False)
+    except (OSError, ValueError, zipfile.BadZipFile) as e:
+        raise ModelFormatError(
+            f"model file {path!r} is not a readable .npz archive "
+            f"({e}) — truncated or corrupt? refit with "
+            "pcoa/pca --save-model"
+        ) from None
+    try:
+        with npz as mdl:
+            names = set(mdl.files)
+            if "schema_version" not in names:
+                raise ModelFormatError(
+                    f"model file {path!r} has no 'schema_version' field "
+                    "— written by a pre-versioning build; refit it with "
+                    "pcoa/pca --save-model to upgrade"
+                )
+            version = int(mdl["schema_version"])
+            if version > SCHEMA_VERSION:
+                raise ModelFormatError(
+                    f"model file {path!r} has schema_version {version}, "
+                    f"newer than this build understands "
+                    f"({SCHEMA_VERSION}) — upgrade the code or refit"
+                )
+            if "kind" not in names:
+                raise ModelFormatError(
+                    f"model file {path!r} is missing the 'kind' field"
+                )
+            kind = str(mdl["kind"])
+            if kind not in _MODEL_KEYS:
+                raise ModelFormatError(
+                    f"model file {path!r} has unknown kind {kind!r} "
+                    f"(supported: {sorted(_MODEL_KEYS)})"
+                )
+            missing = [k for k in _MODEL_KEYS[kind] if k not in names]
+            if missing:
+                raise ModelFormatError(
+                    f"model file {path!r} (kind={kind!r}, "
+                    f"schema_version {version}) is missing required "
+                    f"field(s) {missing} — truncated save or a file "
+                    "from an incompatible build; refit with "
+                    "pcoa/pca --save-model"
+                )
+            cm, gr = (("s_colmean", "s_grand") if kind == "pca"
+                      else ("d2_colmean", "d2_grand"))
+            return ProjectionModel(
+                kind=kind,
+                metric=str(mdl["metric"]),
+                eigvecs=np.asarray(mdl["eigvecs"], np.float64),
+                eigvals=np.asarray(mdl["eigvals"], np.float64),
+                colmean=np.asarray(mdl[cm], np.float64),
+                grand=float(mdl[gr]),
+                sample_ids=[str(s) for s in mdl["sample_ids"]],
+                schema_version=version,
+            )
+    except (ValueError, OSError, zipfile.BadZipFile) as e:
+        # Member reads of a truncated-but-openable archive fail here.
+        if isinstance(e, ModelFormatError):
+            raise
+        raise ModelFormatError(
+            f"model file {path!r} could not be decoded ({e}) — "
+            "truncated or corrupt? refit with pcoa/pca --save-model"
+        ) from None
+
+
+def check_projectable(model: ProjectionModel) -> tuple[str, ...]:
+    """The (kind, metric) projectability gate, shared by the offline job
+    and the serving engine — returns the cross statistics to stream."""
+    stats = PROJECTABLE.get((model.kind, model.metric))
+    if stats is None:
+        raise ValueError(
+            f"model (kind={model.kind!r}, metric={model.metric!r}) is "
+            f"not projectable (supported: {sorted(PROJECTABLE)})"
+        )
+    return stats
+
+
+def check_reference_panel(model: ProjectionModel, source_ref) -> None:
+    """Refuse a reference source that is not the panel the model was
+    fitted on (shared by the offline job and the serving engine) —
+    cross-statistics against the wrong genotypes would project silently
+    wrong coordinates."""
+    if model.sample_ids != list(source_ref.sample_ids):
+        raise ValueError(
+            "reference source sample ids do not match the panel the "
+            f"model was fitted on ({source_ref.n_samples} vs "
+            f"{len(model.sample_ids)} samples"
+            + (
+                "; ids differ"
+                if source_ref.n_samples == len(model.sample_ids)
+                else ""
+            )
+            + ") — cross-distances against the wrong genotypes "
+            "would project silently wrong coordinates"
+        )
+
 
 def save_model(
     path: str,
@@ -78,6 +255,7 @@ def save_model(
     d2 = np.asarray(distance, np.float64) ** 2
     np.savez(
         path,
+        schema_version=np.int64(SCHEMA_VERSION),
         kind=np.asarray("pcoa"),
         eigvecs=v,
         eigvals=vals[keep],
@@ -109,6 +287,7 @@ def save_pca_model(
     s = np.asarray(similarity, np.float64)
     np.savez(
         path,
+        schema_version=np.int64(SCHEMA_VERSION),
         kind=np.asarray("pca"),
         eigvecs=v,
         eigvals=vals[keep],
@@ -218,8 +397,45 @@ def cross_plan_for(
     return CrossPlan(mesh, mode)
 
 
-@lru_cache(maxsize=32)
+# Explicit, clearable memo of compiled tiled cross updates (was a
+# module-level @lru_cache: in a long-lived server its entries pin mesh/
+# sharding objects and compiled shard_map closures for the life of the
+# process, across model hot-reloads — clear_caches() is the reload
+# hook). Bounded LRU so even a pathological plan churn cannot grow it
+# past the old lru_cache ceiling.
+_CROSS_UPDATE_CACHE: OrderedDict = OrderedDict()
+_CROSS_UPDATE_CAPACITY = 32
+
+
 def _cross_update_tiled(plan: CrossPlan, stats: tuple[str, ...]):
+    key = (plan, stats)
+    fn = _CROSS_UPDATE_CACHE.get(key)
+    if fn is not None:
+        _CROSS_UPDATE_CACHE.move_to_end(key)
+        return fn
+    fn = _build_cross_update_tiled(plan, stats)
+    _CROSS_UPDATE_CACHE[key] = fn
+    while len(_CROSS_UPDATE_CACHE) > _CROSS_UPDATE_CAPACITY:
+        _CROSS_UPDATE_CACHE.popitem(last=False)
+    return fn
+
+
+def clear_caches() -> None:
+    """Drop every compiled-closure cache this module holds: the tiled
+    cross-update memo above and the shape-keyed jit caches of the
+    module-level compiled functions. A long-lived server calls this on
+    model hot-reload so stale meshes/shardings/compiled programs cannot
+    accumulate across reloads (tests pin that the caches do not grow
+    unboundedly under a reload loop)."""
+    _CROSS_UPDATE_CACHE.clear()
+    for fn in (_update_cross, _af_moments, _cross_phi, _project,
+               _project_pca):
+        clear = getattr(fn, "clear_cache", None)
+        if clear is not None:
+            clear()
+
+
+def _build_cross_update_tiled(plan: CrossPlan, stats: tuple[str, ...]):
     """shard_map cross update: each device contracts its (rows_i bn,
     rows_j br) operand slices into its own tile — collective-free by
     construction (the same reasoning as the symmetric tile2d update:
@@ -528,41 +744,16 @@ def pcoa_project_job(
     block widths and, when available, positions are validated as the
     two streams are zipped.
     """
-    with np.load(model_path, allow_pickle=False) as mdl:
-        metric = str(mdl["metric"])
-        kind = str(mdl["kind"]) if "kind" in mdl else "pcoa"
-        if (kind, metric) not in PROJECTABLE:
-            raise ValueError(
-                f"model (kind={kind!r}, metric={metric!r}) is not "
-                f"projectable (supported: {sorted(PROJECTABLE)})"
-            )
-        n_ref = mdl["eigvecs"].shape[0]
-        model_ids = [str(s) for s in mdl["sample_ids"]]
-        if model_ids != list(source_ref.sample_ids):
-            raise ValueError(
-                "reference source sample ids do not match the panel the "
-                f"model was fitted on ({source_ref.n_samples} vs "
-                f"{len(model_ids)} samples"
-                + (
-                    "; ids differ"
-                    if source_ref.n_samples == len(model_ids)
-                    else ""
-                )
-                + ") — cross-distances against the wrong genotypes "
-                "would project silently wrong coordinates"
-            )
-        eigvecs = jnp.asarray(mdl["eigvecs"], jnp.float32)
-        eigvals = jnp.asarray(mdl["eigvals"], jnp.float32)
-        if kind == "pca":
-            center_stats = (
-                jnp.asarray(mdl["s_colmean"], jnp.float32),
-                jnp.float32(mdl["s_grand"]),
-            )
-        else:
-            center_stats = (
-                jnp.asarray(mdl["d2_colmean"], jnp.float32),
-                jnp.float32(mdl["d2_grand"]),
-            )
+    model = load_model(model_path)
+    kind, metric = model.kind, model.metric
+    check_projectable(model)
+    check_reference_panel(model, source_ref)
+    eigvecs = jnp.asarray(model.eigvecs, jnp.float32)
+    eigvals = jnp.asarray(model.eigvals, jnp.float32)
+    center_stats = (
+        jnp.asarray(model.colmean, jnp.float32),
+        jnp.float32(model.grand),
+    )
 
     timer = PhaseTimer()
     stats = PROJECTABLE[(kind, metric)]

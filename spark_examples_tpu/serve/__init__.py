@@ -1,0 +1,47 @@
+"""Online projection serving — the long-lived counterpart of the
+``project`` CLI.
+
+The reference family's flagship workflow is *fit once on a reference
+panel, project every new cohort into the same coordinates*; offline,
+every projection pays a full cold start (model load, panel re-stream,
+fresh jit compile). This package keeps all of that resident: the packed
+reference blocks and centering statistics live on device, the compiled
+programs are warmed once, and projection queries arrive through an
+async micro-batching queue with a production envelope around it —
+bounded admission with explicit load-shedding, per-request deadlines
+and cancellation, an LRU result cache keyed by genotype digest, and
+graceful drain. Served coordinates are bit-identical to the offline
+``project`` CLI by construction (see serve/engine.py).
+
+Layers:
+
+- :class:`~spark_examples_tpu.serve.engine.ProjectionEngine` — the
+  device-resident model + panel + compiled step (no queueing).
+- :class:`~spark_examples_tpu.serve.server.ProjectionServer` — the
+  async micro-batcher and admission envelope over one engine.
+- :mod:`~spark_examples_tpu.serve.http` — a thin stdlib HTTP front.
+- :mod:`~spark_examples_tpu.serve.loadgen` — the closed-loop load
+  generator behind ``bench.py --serve`` and the ``serve --loadgen``
+  CLI mode (offered vs sustained QPS, latency p50/p99).
+"""
+
+from spark_examples_tpu.serve.cache import ResultCache, genotype_digest
+from spark_examples_tpu.serve.engine import ProjectionEngine
+from spark_examples_tpu.serve.loadgen import run_loadgen
+from spark_examples_tpu.serve.server import (
+    DeadlineExceeded,
+    ProjectionServer,
+    ServerClosed,
+    ServerOverloaded,
+)
+
+__all__ = [
+    "DeadlineExceeded",
+    "ProjectionEngine",
+    "ProjectionServer",
+    "ResultCache",
+    "ServerClosed",
+    "ServerOverloaded",
+    "genotype_digest",
+    "run_loadgen",
+]

@@ -1,0 +1,72 @@
+"""LRU result cache for the projection server.
+
+Keyed by a digest of the query genotype block (plus the model's content
+fingerprint as a namespace, so a hot-reloaded model can never serve a
+stale result). Values are the final (1, k) coordinate rows — tiny next
+to the cross-statistics work a miss costs, so a few hundred entries are
+effectively free and absorb the classic serving pattern of repeated
+identical queries (retries, duplicate submissions, shared panels).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+def genotype_digest(genotypes: np.ndarray, namespace: str = "") -> str:
+    """Content digest of one query's genotype block.
+
+    Shape and dtype are folded in so a (V,) int8 query and some other
+    buffer with the same bytes cannot collide; ``namespace`` carries the
+    model fingerprint (ProjectionModel.digest())."""
+    g = np.ascontiguousarray(genotypes)
+    h = hashlib.sha256()
+    h.update(f"{namespace}|{g.dtype.str}|{g.shape}|".encode())
+    h.update(g.tobytes())
+    return h.hexdigest()
+
+
+class ResultCache:
+    """Thread-safe bounded LRU: get/put under one lock.
+
+    Stored arrays are marked read-only and returned as-is (the server
+    copies on the way out only if a caller asks to mutate); capacity 0
+    disables storage entirely (every get misses)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(0, int(capacity))
+        self._data: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def get(self, key: str) -> np.ndarray | None:
+        with self._lock:
+            value = self._data.get(key)
+            if value is not None:
+                self._data.move_to_end(key)
+            return value
+
+    def put(self, key: str, value: np.ndarray) -> None:
+        if self.capacity == 0:
+            return
+        # A genuine copy, not ascontiguousarray: freezing an alias of
+        # the caller's array would make the Future result handed to the
+        # client read-only whenever caching happens to be on.
+        frozen = np.array(value)
+        frozen.setflags(write=False)
+        with self._lock:
+            self._data[key] = frozen
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()

@@ -1,0 +1,181 @@
+"""Device-resident projection engine: model + reference panel staged
+once, one compiled shape for every micro-batch.
+
+Offline, ``project`` streams the reference panel from disk for every
+cohort and pays a fresh jit compile per process. The engine instead
+stages the panel's genotype blocks into device memory **once** at
+startup, together with the model's eigenvectors and centering
+statistics, and answers micro-batches through two compiled programs
+warmed at init:
+
+- the batched cross-statistics update — the query batch is padded to a
+  fixed ``(max_batch, V)`` shape with hom-ref rows, so ONE jit cache
+  entry per staged block width serves every batch size (padding rows
+  cost matmul FLOPs but their outputs are discarded);
+- the per-row finalize at shape ``(1, N_ref)`` — the SAME jitted
+  ``_project`` / ``_project_pca`` the offline single-query path runs,
+  at the same shape.
+
+**Bit-identity with the offline CLI is by construction, not luck**: the
+cross statistics are int32 sums of int8 matmul products, exact for any
+block partition and any batch shape (padding contributes rows that are
+simply never read), so each live row of the padded accumulator equals
+the offline single-query accumulator bit for bit; the finalize then
+runs the identical compiled program on identical inputs. Tests pin
+this for batch sizes 1, 3, max, and max+1 on both model kinds.
+
+The engine is intentionally queue-free and NOT thread-safe: the server
+(serve/server.py) owns one engine and serializes all device work
+through its single batching worker.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_examples_tpu.pipelines import project as P
+
+
+class ProjectionEngine:
+    """A loaded model + staged reference panel + compiled batch step.
+
+    ``model`` is a :class:`~spark_examples_tpu.pipelines.project.
+    ProjectionModel` or a path to a saved ``.npz``; ``source_ref`` must
+    be the panel the model was fitted on (validated by sample ids, the
+    same guard as the offline job). ``block_variants`` is the staging
+    granularity — it does not need to match the width the model was
+    fitted with (integer accumulation is partition-invariant).
+    """
+
+    def __init__(self, model, source_ref, block_variants: int = 8192,
+                 max_batch: int = 8, warm: bool = True):
+        if isinstance(model, (str, bytes)):
+            model = P.load_model(model)
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.block_variants = int(block_variants)
+        self._install_model(model)
+        P.check_reference_panel(model, source_ref)  # before any staging
+        self._panel_ids = list(source_ref.sample_ids)
+        # Stage the panel once: dense int8 blocks, device-resident for
+        # the life of the server (the whole point — no per-request
+        # panel re-stream). Block shapes are fixed across requests, so
+        # the compiled update's cache stays at one entry per distinct
+        # staged width (full + ragged tail).
+        self._ref_blocks = []
+        n_variants = 0
+        for block, meta in source_ref.blocks(self.block_variants):
+            self._ref_blocks.append((jax.device_put(block), meta))
+            n_variants = meta.stop
+        if n_variants == 0:
+            raise ValueError("reference source yielded no variants")
+        self.n_variants = n_variants
+        if warm:
+            self.warmup()
+
+    def _install_model(self, model: "P.ProjectionModel") -> None:
+        """Validate + move a model's statistics to device (init and
+        hot-reload share this)."""
+        self.stats = P.check_projectable(model)
+        self.model = model
+        # f32 casts at the device boundary — exactly what the offline
+        # path does with the freshly np.load-ed f64 arrays.
+        self._eigvecs = jax.device_put(
+            np.asarray(model.eigvecs, np.float32))
+        self._eigvals = jax.device_put(
+            np.asarray(model.eigvals, np.float32))
+        self._colmean = jax.device_put(
+            np.asarray(model.colmean, np.float32))
+        self._grand = jnp.float32(model.grand)
+
+    @property
+    def n_ref(self) -> int:
+        return self.model.n_ref
+
+    @property
+    def n_components(self) -> int:
+        return self.model.n_components
+
+    def warmup(self) -> None:
+        """Run one padded batch end to end so no request ever pays the
+        compile (the cold start the server exists to amortize)."""
+        self.project_batch(
+            np.zeros((1, self.n_variants), np.int8))
+
+    def reload_model(self, model) -> None:
+        """Hot-swap the served model (same panel), dropping the compiled
+        -closure caches the old model may pin (project.clear_caches —
+        the satellite this PR's clearable cache exists for). The panel
+        must match the new model's sample ids; the staged blocks are
+        reused as-is. Commit is all-or-nothing: a failure anywhere
+        (including the warmup compile) restores the old model, so the
+        caller's 'old model still serving' contract holds."""
+        if isinstance(model, (str, bytes)):
+            model = P.load_model(model)
+        P.check_projectable(model)
+        if model.sample_ids != self._panel_ids:
+            raise ValueError(
+                "hot-reload refused: the new model was fitted on a "
+                "different reference panel than the one staged on "
+                "device — restart the server against the right panel"
+            )
+        old = (self.model, self.stats, self._eigvecs, self._eigvals,
+               self._colmean, self._grand)
+        P.clear_caches()
+        try:
+            self._install_model(model)
+            self.warmup()
+        except BaseException:
+            (self.model, self.stats, self._eigvecs, self._eigvals,
+             self._colmean, self._grand) = old
+            raise
+
+    def project_batch(self, genotypes: np.ndarray) -> np.ndarray:
+        """(b, V) int8 query genotypes -> (b, k) f32 coordinates,
+        b <= max_batch. Bit-identical per row to the offline
+        single-query ``pcoa_project_job`` (see module docstring)."""
+        g = np.ascontiguousarray(genotypes, dtype=np.int8)
+        if g.ndim != 2 or g.shape[1] != self.n_variants:
+            raise ValueError(
+                f"query batch must be (b, {self.n_variants}) int8 "
+                f"dosages, got {g.shape}"
+            )
+        b = g.shape[0]
+        if not 1 <= b <= self.max_batch:
+            raise ValueError(
+                f"batch of {b} rows outside [1, {self.max_batch}]"
+            )
+        if b < self.max_batch:
+            # Hom-ref padding rows: any valid dosage works — their
+            # accumulator rows are computed and never read.
+            g = np.concatenate(
+                [g, np.zeros((self.max_batch - b, self.n_variants),
+                             np.int8)], axis=0)
+        acc = {
+            k: jnp.zeros((self.max_batch, self.n_ref), jnp.int32)
+            for k in self.stats
+        }
+        for ref_dev, meta in self._ref_blocks:
+            q = jax.device_put(
+                np.ascontiguousarray(g[:, meta.start:meta.stop]))
+            acc = P._update_cross(acc, q, ref_dev)
+        rows = [np.asarray(self._finalize_row(acc, i)) for i in range(b)]
+        return np.concatenate(rows, axis=0)
+
+    def _finalize_row(self, acc, i: int):
+        """One live row at shape (1, N_ref) through the SAME compiled
+        finalize as the offline single-query path — the bit-identity
+        anchor."""
+        if self.model.kind == "pca":
+            return P._project_pca(
+                acc["s"][i:i + 1], self._colmean, self._grand,
+                self._eigvecs,
+            )
+        return P._project(
+            acc["m"][i:i + 1], acc["d1"][i:i + 1], self._colmean,
+            self._grand, self._eigvecs, self._eigvals,
+        )

@@ -1,0 +1,146 @@
+"""Thin stdlib HTTP front for the projection server.
+
+One process, no dependencies: ``ThreadingHTTPServer`` handlers block on
+the projection Future while the batching worker coalesces concurrent
+requests — HTTP concurrency IS the micro-batch source. Endpoints:
+
+- ``POST /project`` — body ``{"genotypes": [<V int8 dosages>],
+  "deadline_ms": <optional>}``; answers ``{"coords": [[...]]}``.
+  Errors map onto status codes the envelope semantics imply: 429
+  overloaded (shed), 503 draining, 504 deadline, 400 malformed.
+- ``GET /healthz`` — liveness + in-flight/backlog counts.
+- ``GET /stats`` — the server's request accounting + the compact
+  ``serve.*`` latency digest.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.serve.server import (
+    DeadlineExceeded,
+    ProjectionServer,
+    ServerClosed,
+    ServerOverloaded,
+)
+
+
+def _make_handler(pserver: ProjectionServer):
+    class Handler(BaseHTTPRequestHandler):
+        # Silence the default per-request stderr lines (telemetry is the
+        # observability surface, not the access log).
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "status": "draining" if pserver._closed else "serving",
+                    "in_flight": pserver.in_flight,
+                    "n_variants": pserver.engine.n_variants,
+                    "n_components": pserver.engine.n_components,
+                    "max_batch": pserver.max_batch,
+                })
+                return
+            if self.path == "/stats":
+                hists = telemetry.metrics_snapshot()["histograms"]
+                lat = hists.get("serve.latency_s", {})
+                rows = hists.get("serve.batch_rows", {})
+                self._reply(200, {
+                    **pserver.stats.snapshot(),
+                    "latency_p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+                    "latency_p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+                    "batch_rows_mean": round(rows.get("mean", 0.0), 2),
+                })
+                return
+            self._reply(404, {"error": f"unknown path {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802 (stdlib API)
+            if self.path != "/project":
+                self._reply(404, {"error": f"unknown path {self.path!r}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                raw = np.asarray(req["genotypes"])
+                if raw.dtype.kind not in "iu":
+                    raise ValueError(
+                        "genotypes must be integer dosages "
+                        f"(got {raw.dtype} values)")
+                # dtype= on the original list (not .astype, which wraps
+                # silently): an out-of-int8-range dosage raises here and
+                # becomes a 400, never a dropped socket.
+                genotypes = np.asarray(req["genotypes"], dtype=np.int8)
+                deadline_ms = req.get("deadline_ms")
+                # Converted HERE so a non-numeric deadline is a 400
+                # (client error), not a 500 from deep in the submit.
+                deadline_s = (
+                    float(deadline_ms) / 1e3 if deadline_ms else None)
+            except (ValueError, KeyError, TypeError, OverflowError) as e:
+                self._reply(400, {"error": f"bad request body: {e}"})
+                return
+            try:
+                coords = pserver.project(genotypes, deadline_s=deadline_s)
+            except ServerOverloaded as e:
+                self._reply(429, {"error": str(e)})
+            except DeadlineExceeded as e:
+                self._reply(504, {"error": str(e)})
+            except ServerClosed as e:
+                self._reply(503, {"error": str(e)})
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # answered, never a dropped socket
+                self._reply(500, {"error": repr(e)})
+            else:
+                self._reply(200, {"coords": coords.tolist()})
+
+    return Handler
+
+
+class ProjectionHTTPServer:
+    """Lifecycle wrapper: bind (port 0 = ephemeral), serve in a daemon
+    thread or in the foreground, shut down idempotently."""
+
+    def __init__(self, pserver: ProjectionServer,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._httpd = ThreadingHTTPServer(
+            (host, port), _make_handler(pserver))
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    def serve_in_thread(self) -> "ProjectionHTTPServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="projection-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def start_http_server(pserver: ProjectionServer, host: str = "127.0.0.1",
+                      port: int = 0) -> ProjectionHTTPServer:
+    """Bind + serve in a background thread; returns the wrapper (read
+    ``.port`` for the ephemeral bind)."""
+    return ProjectionHTTPServer(pserver, host=host, port=port).serve_in_thread()

@@ -1,0 +1,420 @@
+"""The async micro-batching projection server.
+
+One background worker owns the engine (and therefore all device work);
+clients talk to it through :meth:`ProjectionServer.submit`, which
+returns a ``concurrent.futures.Future``. The production envelope:
+
+- **Admission control / load-shedding.** The request queue is bounded
+  (``max_queue``); a full queue rejects the submit with an explicit
+  :class:`ServerOverloaded` instead of letting latency grow without
+  bound. Shedding is counted (``serve.shed``) — an overloaded server is
+  *visibly* overloaded.
+- **Micro-batching.** The worker takes the first waiting request, then
+  lingers up to ``max_linger_s`` for more, up to the engine's
+  ``max_batch``; the batch is padded to the engine's fixed compiled
+  shape, so one jit cache entry serves every batch size.
+- **Deadlines / cancellation.** A request whose deadline passed before
+  batch pickup is answered with :class:`DeadlineExceeded` rather than
+  occupying device time; a Future cancelled by its client is dropped at
+  pickup. Both are counted.
+- **Result cache.** Hits by genotype digest (namespaced by the model
+  fingerprint) are answered at submit — no queue slot, no device work.
+- **Graceful drain.** :meth:`drain` closes admission, waits for every
+  in-flight request to resolve, and joins the worker; anything still
+  unanswered after the timeout is failed explicitly with
+  :class:`ServerClosed` — no silent drops, no hang.
+- **Chaos.** Every request crosses the ``serve.request`` fault site
+  (core/faults.py) in the worker's assembly sweep: an ``io_error``
+  fails exactly that request, a ``delay`` stalls the worker so the
+  bounded queue must shed, a ``kill`` simulates preemption.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.serve.cache import ResultCache, genotype_digest
+from spark_examples_tpu.serve.engine import ProjectionEngine
+
+
+class ServerOverloaded(RuntimeError):
+    """Admission rejected: the bounded request queue is full. The
+    explicit alternative to unbounded queueing latency — clients back
+    off or retry elsewhere."""
+
+
+class ServerClosed(RuntimeError):
+    """Submit after drain/close (or a request stranded by shutdown)."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before the server got to it."""
+
+
+@dataclass
+class _Pending:
+    genotypes: np.ndarray  # (V,) int8, contiguous
+    future: Future
+    digest: str | None
+    t_submit: float  # perf_counter at admission
+    deadline: float | None  # perf_counter deadline, None = none
+    finished: bool = False  # guards double in-flight decrement
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+@dataclass
+class ServerStats:
+    """Point-in-time request accounting (monotonic counters; the same
+    numbers flow into the telemetry registry under ``serve.*``)."""
+
+    admitted: int = 0
+    completed: int = 0
+    shed: int = 0
+    cache_hits: int = 0
+    errors: int = 0
+    deadline_expired: int = 0
+    cancelled: int = 0
+    batches: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock,
+                                 repr=False)
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "shed": self.shed,
+                "cache_hits": self.cache_hits,
+                "errors": self.errors,
+                "deadline_expired": self.deadline_expired,
+                "cancelled": self.cancelled,
+                "batches": self.batches,
+            }
+
+
+class ProjectionServer:
+    """Async micro-batching front over one :class:`ProjectionEngine`."""
+
+    def __init__(self, engine: ProjectionEngine,
+                 max_linger_s: float = 0.002,
+                 max_queue: int = 64,
+                 cache_entries: int = 256,
+                 default_deadline_s: float | None = None):
+        self.engine = engine
+        self.max_batch = engine.max_batch
+        self.max_linger_s = float(max_linger_s)
+        self.default_deadline_s = default_deadline_s
+        self._q: queue.Queue[_Pending] = queue.Queue(
+            maxsize=max(1, int(max_queue)))
+        self._cache = ResultCache(cache_entries)
+        self._cache_ns = engine.model.digest()
+        self.stats = ServerStats()
+        self._closed = False
+        self._drained = False
+        self._drain_clean = True
+        self._stop = threading.Event()
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
+        # Serializes admission against the drain transition: drain flips
+        # _closed under this lock, so a submit has either completed its
+        # enqueue BEFORE the flip (drain then waits it out via
+        # in_flight) or observes _closed — a request can never slip into
+        # the queue after drain's backstop sweep and hang its Future.
+        self._admission_lock = threading.Lock()
+        # Serializes device work (the worker's batch step) against model
+        # hot-reload — a reload must never tear a batch mid-flight.
+        self._engine_lock = threading.Lock()
+        self._idle = threading.Event()  # set while in_flight == 0
+        self._idle.set()
+        self._worker: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ProjectionServer":
+        if self._worker is not None:
+            raise RuntimeError("server already started")
+        self._worker = threading.Thread(
+            target=self._run, name="projection-serve-worker", daemon=True)
+        self._worker.start()
+        return self
+
+    def __enter__(self) -> "ProjectionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Close admission and wait for every in-flight request to
+        resolve, then stop the worker. Returns True on a clean drain;
+        on timeout (or a dead worker) the stragglers are failed with
+        ServerClosed — an admitted request is ALWAYS answered.
+        Idempotent: a second drain (e.g. close() after drain()) returns
+        the first one's verdict without re-walking the shutdown."""
+        with self._admission_lock:
+            if self._drained:
+                return self._drain_clean
+            self._closed = True
+        clean = True
+        with telemetry.span("serve.drain", cat="serve"):
+            deadline = time.perf_counter() + timeout
+            while not self._idle.wait(timeout=0.05):
+                alive = self._worker is not None and self._worker.is_alive()
+                if time.perf_counter() > deadline or not alive:
+                    clean = False
+                    break
+            self._stop.set()
+            if self._worker is not None:
+                self._worker.join(timeout=max(1.0, timeout / 2))
+                clean = clean and not self._worker.is_alive()
+            # Backstop: anything the worker never picked up (it died, or
+            # the drain timed out) is failed loudly, never dropped.
+            while True:
+                try:
+                    p = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                self._fail(p, ServerClosed("server drained before this "
+                                           "request was processed"))
+        self._drained = True
+        self._drain_clean = clean
+        return clean
+
+    def close(self) -> None:
+        if self._worker is None:
+            self._closed = True
+            return
+        self.drain()  # idempotent: a no-op after an explicit drain()
+
+    @property
+    def in_flight(self) -> int:
+        with self._in_flight_lock:
+            return self._in_flight
+
+    def reload_model(self, model) -> None:
+        """Hot-swap the served model (same reference panel) without
+        restarting: waits out any in-flight batch (the engine lock), then
+        swaps + re-warms the engine and clears/re-namespaces the result
+        cache so a stale coordinate can never be served."""
+        if isinstance(model, (str, bytes)):
+            from spark_examples_tpu.pipelines.project import load_model
+
+            model = load_model(model)
+        with self._engine_lock:
+            # Re-namespace + clear BEFORE the engine swap: a submit
+            # racing the reload either hits the old cache while the old
+            # model is still installed (consistent), or — once the
+            # namespace flips — misses and queues behind the engine
+            # lock, to be served by (and cached under) the new model.
+            # The inverse order had a window serving old-model cache
+            # entries after the new model was live.
+            old_ns = self._cache_ns
+            self._cache_ns = model.digest()
+            self._cache.clear()
+            try:
+                self.engine.reload_model(model)
+            except BaseException:
+                # Rejected reload (e.g. wrong panel): the old model is
+                # still serving — restore its namespace (the cache is
+                # already empty, so nothing stale can ever match).
+                self._cache_ns = old_ns
+                raise
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, genotypes: np.ndarray,
+               deadline_s: float | None = None) -> Future:
+        """Admit one single-sample query; returns a Future resolving to
+        its (1, k) coordinates. Raises ServerOverloaded when the bounded
+        queue is full, ServerClosed after drain, ValueError on a
+        malformed query."""
+        if self._closed:
+            raise ServerClosed("server is draining/closed")
+        g = np.ascontiguousarray(genotypes, dtype=np.int8)
+        if g.ndim == 2 and g.shape[0] == 1:
+            g = g[0]
+        if g.ndim != 1 or g.shape[0] != self.engine.n_variants:
+            raise ValueError(
+                f"a query is one sample's ({self.engine.n_variants},) "
+                f"int8 dosage vector, got shape {g.shape}"
+            )
+        t0 = time.perf_counter()
+        digest = None
+        if self._cache.capacity:
+            digest = genotype_digest(g, namespace=self._cache_ns)
+            hit = self._cache.get(digest)
+            if hit is not None:
+                telemetry.count("serve.cache_hits")
+                telemetry.observe("serve.latency_s",
+                                  time.perf_counter() - t0)
+                with self.stats.lock:
+                    self.stats.cache_hits += 1
+                    self.stats.completed += 1
+                fut: Future = Future()
+                # Copy (k floats): a hit hands out the same writable
+                # result a miss does, never the cache's frozen storage.
+                fut.set_result(np.array(hit))
+                return fut
+            telemetry.count("serve.cache_misses")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        pending = _Pending(
+            genotypes=g,
+            future=Future(),
+            digest=digest,
+            t_submit=t0,
+            deadline=(t0 + deadline_s) if deadline_s else None,
+        )
+        # Admission happens under the drain-transition lock (see
+        # __init__), and in_flight is raised BEFORE the put: the worker
+        # may finish the request between put and a late increment, and
+        # drain would then see a phantom in-flight forever.
+        with self._admission_lock:
+            if self._closed:
+                raise ServerClosed("server is draining/closed")
+            self._track(+1)
+            try:
+                self._q.put_nowait(pending)
+            except queue.Full:
+                self._track(-1)
+                telemetry.count("serve.shed")
+                with self.stats.lock:
+                    self.stats.shed += 1
+                raise ServerOverloaded(
+                    f"admission queue full ({self._q.maxsize} waiting); "
+                    "retry with backoff"
+                ) from None
+        telemetry.count("serve.requests")
+        with self.stats.lock:
+            self.stats.admitted += 1
+        return pending.future
+
+    def project(self, genotypes: np.ndarray,
+                timeout: float | None = None,
+                deadline_s: float | None = None) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(genotypes, deadline_s=deadline_s).result(
+            timeout=timeout)
+
+    # -- worker ------------------------------------------------------------
+
+    def _track(self, delta: int) -> None:
+        with self._in_flight_lock:
+            self._in_flight += delta
+            n = self._in_flight
+            # The idle event flips INSIDE the lock: set/clear outside it
+            # can interleave inverted (0->1 clears after 1->0 set) and
+            # mark an occupied server idle — drain would then stop the
+            # worker under a live request.
+            if n == 0:
+                self._idle.set()
+            else:
+                self._idle.clear()
+            # Gauge published inside the lock for the same reason the
+            # event flips inside it: out-of-order publishes would leave
+            # the exported backlog reading stale/inverted.
+            telemetry.gauge_set("serve.in_flight", n)
+
+    def _finish(self, p: _Pending) -> None:
+        if not p.finished:
+            p.finished = True
+            self._track(-1)
+
+    def _fail(self, p: _Pending, exc: BaseException) -> None:
+        if not p.future.done():
+            p.future.set_exception(exc)
+        self._finish(p)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self._collect()
+            if batch:
+                try:
+                    self._process(batch)
+                except BaseException as e:  # backstop: answer, don't die
+                    for p in batch:
+                        self._fail(p, e)
+
+    def _collect(self) -> list[_Pending]:
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        linger_until = time.perf_counter() + self.max_linger_s
+        while len(batch) < self.max_batch:
+            remaining = linger_until - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _process(self, batch: list[_Pending]) -> None:
+        with telemetry.span("serve.assemble", cat="serve"):
+            live: list[_Pending] = []
+            for p in batch:
+                now = time.perf_counter()
+                telemetry.observe("serve.enqueue_wait_s", now - p.t_submit)
+                try:
+                    # Chaos site: per admitted request (see module doc).
+                    faults.fire("serve.request")
+                except BaseException as e:
+                    telemetry.count("serve.errors")
+                    with self.stats.lock:
+                        self.stats.errors += 1
+                    self._fail(p, e)
+                    continue
+                if p.expired(now):
+                    telemetry.count("serve.deadline_expired")
+                    with self.stats.lock:
+                        self.stats.deadline_expired += 1
+                    self._fail(p, DeadlineExceeded(
+                        "deadline passed before batch pickup"))
+                    continue
+                if not p.future.set_running_or_notify_cancel():
+                    telemetry.count("serve.cancelled")
+                    with self.stats.lock:
+                        self.stats.cancelled += 1
+                    self._finish(p)
+                    continue
+                live.append(p)
+            if not live:
+                return
+            g = np.stack([p.genotypes for p in live])
+        with telemetry.span("serve.device_step", cat="serve",
+                            rows=len(live)):
+            try:
+                with self._engine_lock:
+                    coords = self.engine.project_batch(g)
+            except BaseException as e:
+                telemetry.count("serve.errors", len(live))
+                with self.stats.lock:
+                    self.stats.errors += len(live)
+                for p in live:
+                    self._fail(p, e)
+                return
+        telemetry.observe("serve.batch_rows", len(live))
+        with self.stats.lock:
+            self.stats.batches += 1
+        now = time.perf_counter()
+        for p, row in zip(live, coords):
+            result = row[None, :]
+            if p.digest is not None:
+                self._cache.put(p.digest, result)
+            p.future.set_result(result)
+            telemetry.observe("serve.latency_s", now - p.t_submit)
+            with self.stats.lock:
+                self.stats.completed += 1
+            self._finish(p)

@@ -287,6 +287,112 @@ def test_single_sample_projection_does_not_warn(rng, tmp_path):
         )
 
 
+def test_model_schema_version_and_friendly_errors(rng, tmp_path):
+    """Satellite: saved models carry schema_version; load_model refuses
+    pre-versioning / future / truncated / field-missing files with a
+    friendly error naming the cause — never a raw KeyError/BadZipFile
+    (the serving layer hot-reloads models and must be able to diagnose
+    a bad file from the exception alone)."""
+    from spark_examples_tpu.pipelines.project import (
+        SCHEMA_VERSION, ModelFormatError, load_model,
+    )
+
+    g = random_genotypes(rng, n=10, v=256)
+    model = str(tmp_path / "m.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=64),
+        compute=ComputeConfig(metric="ibs", num_pc=3),
+        model_path=model,
+    )
+    pcoa_job(job, source=ArraySource(g))
+    with np.load(model) as mdl:
+        assert int(mdl["schema_version"]) == SCHEMA_VERSION
+        payload = {k: mdl[k] for k in mdl.files}
+    loaded = load_model(model)
+    assert loaded.kind == "pcoa" and loaded.metric == "ibs"
+    assert loaded.n_ref == 10
+    assert loaded.digest() == load_model(model).digest()
+
+    # pre-versioning file -> error naming schema_version + the remedy
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez(legacy, **{k: v for k, v in payload.items()
+                        if k != "schema_version"})
+    with pytest.raises(ModelFormatError, match="schema_version"):
+        load_model(legacy)
+
+    # missing required field -> error NAMES the field
+    broken = str(tmp_path / "broken.npz")
+    np.savez(broken, **{k: v for k, v in payload.items()
+                        if k != "d2_colmean"})
+    with pytest.raises(ModelFormatError, match="d2_colmean"):
+        load_model(broken)
+
+    # a model from a newer build is refused, not misread
+    future = str(tmp_path / "future.npz")
+    np.savez(future, **{**payload,
+                        "schema_version": np.int64(SCHEMA_VERSION + 1)})
+    with pytest.raises(ModelFormatError, match="newer"):
+        load_model(future)
+
+    # truncated archive (the formerly opaque failure) -> friendly error
+    trunc = str(tmp_path / "trunc.npz")
+    raw = open(model, "rb").read()
+    with open(trunc, "wb") as f:
+        f.write(raw[: len(raw) // 3])
+    with pytest.raises(ModelFormatError, match="truncated or corrupt"):
+        load_model(trunc)
+    # ... including through the job surface
+    with pytest.raises(ModelFormatError):
+        pcoa_project_job(
+            job.replace(model_path=None), model_path=trunc,
+            source_new=ArraySource(g), source_ref=ArraySource(g),
+        )
+    # pca models carry the version too
+    pca_model = str(tmp_path / "pca.npz")
+    from spark_examples_tpu.pipelines.jobs import variants_pca_job
+
+    variants_pca_job(
+        JobConfig(ingest=IngestConfig(block_variants=64),
+                  compute=ComputeConfig(num_pc=3), model_path=pca_model),
+        source=ArraySource(g),
+    )
+    assert load_model(pca_model).kind == "pca"
+
+
+def test_cross_update_cache_is_explicit_and_clearable(rng, monkeypatch):
+    """Satellite: the tiled cross-update builder's compiled-closure memo
+    is explicit, LRU-bounded, and clear_caches() empties it — a
+    hot-reload loop cannot grow it unboundedly (the old module-level
+    lru_cache pinned stale mesh/sharding objects for the process
+    lifetime)."""
+    from spark_examples_tpu.core import meshes
+    from spark_examples_tpu.pipelines import project as P
+
+    mesh = meshes.make_mesh()
+    P.clear_caches()
+    assert len(P._CROSS_UPDATE_CACHE) == 0
+    plan = P.CrossPlan(mesh, "tile2d")
+
+    # same key -> one entry, the cached builder is returned
+    fn1 = P._cross_update_tiled(plan, ("m", "d1"))
+    fn2 = P._cross_update_tiled(plan, ("m", "d1"))
+    assert fn1 is fn2
+    assert len(P._CROSS_UPDATE_CACHE) == 1
+
+    # the LRU bound holds under key churn (capacity shrunk for the test)
+    monkeypatch.setattr(P, "_CROSS_UPDATE_CAPACITY", 2)
+    for stats in (("m",), ("d1",), ("s",), ("m", "d1")):
+        P._cross_update_tiled(plan, stats)
+        assert len(P._CROSS_UPDATE_CACHE) <= 2
+
+    # a reload loop stays flat: build -> clear, N times
+    for _ in range(5):
+        P._cross_update_tiled(plan, ("m", "d1"))
+        assert len(P._CROSS_UPDATE_CACHE) >= 1
+        P.clear_caches()
+        assert len(P._CROSS_UPDATE_CACHE) == 0
+
+
 def test_cross_accumulate_tile2d_matches_replicated(rng):
     """VERDICT r4 weak #5: the cross-cohort accumulation under a tile2d
     plan (new rows over i, ref rows over j, no full (A, N_ref) leaf on

@@ -1,0 +1,398 @@
+"""Online projection serving: padded micro-batch bit-identity against
+the offline `project` path, admission control / load-shedding,
+deadlines, the LRU result cache, fault injection at serve.request,
+graceful drain, the closed-loop loadgen, the HTTP front, and the
+tier-1 in-process smoke test."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.core.config import (
+    ComputeConfig, IngestConfig, JobConfig,
+)
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.pipelines.jobs import pcoa_job, variants_pca_job
+from spark_examples_tpu.pipelines.project import pcoa_project_job
+from spark_examples_tpu.serve import (
+    DeadlineExceeded,
+    ProjectionEngine,
+    ProjectionServer,
+    ServerClosed,
+    ServerOverloaded,
+    run_loadgen,
+)
+from tests.conftest import random_genotypes
+
+BV = 128  # staging/fit block width for every test panel
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Serve tests assert on serve.* counters; isolate them (and leave
+    no export directory configured behind)."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure(dir=None)
+
+
+def _fit(tmp_path, rng, kind="pcoa", n=16, v=256, num_pc=4):
+    """Fit a tiny reference panel; returns (panel, model_path, job)."""
+    g_ref = random_genotypes(rng, n=n, v=v, missing_rate=0.1)
+    model = str(tmp_path / f"model_{kind}_{n}x{v}.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=BV),
+        compute=ComputeConfig(
+            metric="ibs" if kind == "pcoa" else None, num_pc=num_pc),
+        model_path=model,
+    )
+    fit = pcoa_job if kind == "pcoa" else variants_pca_job
+    fit(job, source=ArraySource(g_ref))
+    return g_ref, model, job
+
+
+def _offline(job, model, g_ref, query):
+    """The offline single-query `project` path — the serving contract's
+    ground truth."""
+    return pcoa_project_job(
+        job.replace(model_path=None), model_path=model,
+        source_new=ArraySource(
+            query[None, :] if query.ndim == 1 else query),
+        source_ref=ArraySource(g_ref),
+    ).coords
+
+
+@pytest.mark.parametrize("kind", ["pcoa", "pca"])
+def test_batch_padding_equivalence(rng, tmp_path, kind):
+    """Satellite: coordinates from padded micro-batches (sizes 1, 3,
+    max, and max+1 spilling into two batches) are BIT-identical to the
+    single-query offline job, for both projectable model kinds."""
+    g_ref, model, job = _fit(tmp_path, rng, kind=kind, n=24, v=384)
+    max_batch = 4
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=max_batch)
+    queries = random_genotypes(rng, n=max_batch + 1, v=384,
+                               missing_rate=0.1)
+    offline = [_offline(job, model, g_ref, q) for q in queries]
+    for b in (1, 3, max_batch):
+        got = engine.project_batch(queries[:b])
+        assert got.shape == (b, engine.n_components)
+        for i in range(b):
+            np.testing.assert_array_equal(got[i:i + 1], offline[i])
+    # max+1 concurrent submissions must spill into a second batch and
+    # still match per query.
+    server = ProjectionServer(engine, max_linger_s=0.01,
+                              cache_entries=0).start()
+    try:
+        futs = [server.submit(q) for q in queries]
+        for fut, want in zip(futs, offline):
+            np.testing.assert_array_equal(fut.result(timeout=60), want)
+        assert server.stats.snapshot()["batches"] >= 2
+    finally:
+        server.close()
+
+
+def test_serve_smoke(rng, tmp_path):
+    """Tier-1 smoke: start in-process, one request, clean drain."""
+    g_ref, model, job = _fit(tmp_path, rng, n=12, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    query = random_genotypes(rng, n=1, v=256)[0]
+    with ProjectionServer(engine) as server:
+        coords = server.project(query, timeout=60)
+        assert coords.shape == (1, engine.n_components)
+        assert np.isfinite(coords).all()
+    assert server.in_flight == 0
+    with pytest.raises(ServerClosed):
+        server.submit(query)
+
+
+def test_result_cache_hit_and_lru_eviction(rng, tmp_path):
+    g_ref, model, job = _fit(tmp_path, rng, n=12, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    queries = random_genotypes(rng, n=3, v=256)
+    server = ProjectionServer(engine, cache_entries=2).start()
+    try:
+        first = server.project(queries[0], timeout=60)
+        again = server.project(queries[0], timeout=60)
+        np.testing.assert_array_equal(first, again)
+        assert server.stats.snapshot()["cache_hits"] == 1
+        assert telemetry.counter_value("serve.cache_hits") == 1
+        # Two more distinct queries evict queries[0] (capacity 2) —
+        # resubmitting it is a miss, not a stale hit.
+        server.project(queries[1], timeout=60)
+        server.project(queries[2], timeout=60)
+        server.project(queries[0], timeout=60)
+        assert server.stats.snapshot()["cache_hits"] == 1
+        assert telemetry.counter_value("serve.cache_misses") == 4
+    finally:
+        server.close()
+
+
+def test_overload_sheds_and_drains_under_injected_stall(rng, tmp_path):
+    """The acceptance scenario: with a delay fault armed at the new
+    serve.request site, the stalled worker backs the bounded queue up,
+    admission sheds with explicit ServerOverloaded, every ADMITTED
+    request still resolves, and drain is clean — no hang, no deadlock,
+    no silent drop."""
+    g_ref, model, job = _fit(tmp_path, rng, n=12, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine, max_linger_s=0.0, max_queue=2,
+                              cache_entries=0).start()
+    queries = random_genotypes(rng, n=30, v=256)
+    futs, shed = [], 0
+    try:
+        with faults.armed(["serve.request:delay:delay=0.05:max=8"],
+                          seed=3) as inj:
+            for q in queries:
+                try:
+                    futs.append(server.submit(q))
+                except ServerOverloaded:
+                    shed += 1
+            assert shed > 0, "bounded queue never filled"
+            assert futs, "everything shed — queue bound miswired"
+            for fut in futs:  # every admitted request is answered
+                assert fut.result(timeout=60).shape[0] == 1
+            assert inj.fire_count("serve.request") > 0
+        assert server.drain(timeout=60)
+    finally:
+        server.close()
+    assert server.in_flight == 0
+    assert telemetry.counter_value("serve.shed") == shed
+    assert server.stats.snapshot()["shed"] == shed
+
+
+def test_deadline_expires_while_queued(rng, tmp_path):
+    g_ref, model, job = _fit(tmp_path, rng, n=12, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine, max_linger_s=0.0,
+                              cache_entries=0).start()
+    queries = random_genotypes(rng, n=2, v=256)
+    try:
+        with faults.armed(["serve.request:delay:delay=0.2:max=1"]):
+            stalled = server.submit(queries[0])
+            doomed = server.submit(queries[1], deadline_s=0.05)
+            assert stalled.result(timeout=60).shape == (
+                1, engine.n_components)
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=60)
+        assert telemetry.counter_value("serve.deadline_expired") == 1
+        assert server.drain(timeout=60)
+    finally:
+        server.close()
+
+
+def test_injected_io_error_fails_exactly_one_request(rng, tmp_path):
+    g_ref, model, job = _fit(tmp_path, rng, n=12, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine, cache_entries=0).start()
+    queries = random_genotypes(rng, n=4, v=256)
+    try:
+        with faults.armed(["serve.request:io_error:max=1"]):
+            futs = [server.submit(q) for q in queries]
+            outcomes = []
+            for fut in futs:
+                try:
+                    fut.result(timeout=60)
+                    outcomes.append("ok")
+                except faults.InjectedFault:
+                    outcomes.append("fault")
+        assert outcomes.count("fault") == 1
+        assert outcomes.count("ok") == 3
+        assert telemetry.counter_value("serve.errors") == 1
+        assert server.drain(timeout=60)
+    finally:
+        server.close()
+
+
+def test_cancellation_before_pickup(rng, tmp_path):
+    g_ref, model, job = _fit(tmp_path, rng, n=12, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine, max_linger_s=0.0,
+                              cache_entries=0).start()
+    queries = random_genotypes(rng, n=2, v=256)
+    try:
+        with faults.armed(["serve.request:delay:delay=0.2:max=1"]):
+            stalled = server.submit(queries[0])
+            victim = server.submit(queries[1])
+            assert victim.cancel()  # still queued behind the stall
+            stalled.result(timeout=60)
+        assert server.drain(timeout=60)
+        assert server.stats.snapshot()["cancelled"] == 1
+    finally:
+        server.close()
+
+
+def test_loadgen_sustained_qps_and_telemetry_export(rng, tmp_path):
+    """Acceptance: a sustained concurrent-client loadgen run reports
+    nonzero sustained QPS, and latency p50/p99 land in the telemetry
+    export (the same registry numbers the report carries)."""
+    g_ref, model, job = _fit(tmp_path, rng, n=16, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=4)
+    server = ProjectionServer(engine, max_linger_s=0.001, max_queue=32,
+                              cache_entries=8).start()
+    pool = random_genotypes(rng, n=24, v=256)
+    tdir = str(tmp_path / "tel")
+    telemetry.configure(dir=tdir, trace_events=False)
+    try:
+        report = run_loadgen(server, pool, clients=4,
+                             requests_per_client=10)
+        assert server.drain(timeout=60)
+    finally:
+        server.close()
+        telemetry.export()
+        telemetry.configure(dir=None)
+    assert report["completed"] == 40
+    assert report["errors"] == 0 and report["shed"] == 0
+    assert report["sustained_qps"] > 0
+    assert report["offered_qps"] >= report["sustained_qps"]
+    assert report["latency_p99_ms"] >= report["latency_p50_ms"] > 0
+    with open(tmp_path / "tel" / "rank0" / "metrics.json") as f:
+        exported = json.load(f)
+    lat = exported["histograms"]["serve.latency_s"]
+    assert lat["count"] == 40
+    assert lat["p99"] >= lat["p50"] > 0
+    assert exported["counters"]["serve.requests"] > 0
+
+
+def test_http_front(rng, tmp_path):
+    from spark_examples_tpu.serve.http import start_http_server
+
+    g_ref, model, job = _fit(tmp_path, rng, n=10, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine).start()
+    http = start_http_server(server, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    query = random_genotypes(rng, n=1, v=256)[0]
+    try:
+        req = urllib.request.Request(
+            f"{base}/project",
+            data=json.dumps(
+                {"genotypes": [int(x) for x in query]}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        got = np.asarray(out["coords"], np.float32)
+        want = _offline(job, model, g_ref, query).astype(np.float32)
+        np.testing.assert_array_equal(got, want)
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "serving"
+        assert health["n_variants"] == 256
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["completed"] >= 1
+        # malformed bodies are 400s, not dropped sockets: wrong type,
+        # out-of-int8-range dosages, float dosages
+        for body in (b'{"genotypes": "nope"}',
+                     json.dumps({"genotypes": [300] * 256}).encode(),
+                     json.dumps({"genotypes": [0.7] * 256}).encode()):
+            bad = urllib.request.Request(
+                f"{base}/project", data=body, method="POST")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(bad, timeout=30)
+            assert err.value.code == 400
+    finally:
+        http.shutdown()
+        server.close()
+
+
+def test_hot_reload_swaps_model_and_rejects_wrong_panel(rng, tmp_path):
+    g_ref, model3, _ = _fit(tmp_path, rng, n=16, v=256, num_pc=3)
+    # A second model on the SAME panel, different k — legal hot-reload.
+    model5 = str(tmp_path / "m5.npz")
+    job5 = JobConfig(
+        ingest=IngestConfig(block_variants=BV),
+        compute=ComputeConfig(metric="ibs", num_pc=5),
+        model_path=model5,
+    )
+    pcoa_job(job5, source=ArraySource(g_ref))
+    # A model on a DIFFERENT panel — reload must refuse it.
+    other_panel = random_genotypes(rng, n=16, v=256)
+    model_other = str(tmp_path / "other.npz")
+    pcoa_job(job5.replace(model_path=model_other),
+             source=ArraySource(other_panel,
+                                ids=[f"OTHER{i}" for i in range(16)]))
+
+    engine = ProjectionEngine(model3, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    query = random_genotypes(rng, n=1, v=256)[0]
+    server = ProjectionServer(engine, cache_entries=4).start()
+    try:
+        before = server.project(query, timeout=60)
+        server.project(query, timeout=60)  # prime the cache
+        server.reload_model(model5)
+        after = server.project(query, timeout=60)
+        # New model served (more components) and the cache was cleared —
+        # the primed entry could not short-circuit the reload.
+        assert after.shape[1] > before.shape[1]
+        with pytest.raises(ValueError, match="different reference panel"):
+            server.reload_model(model_other)
+        assert server.drain(timeout=60)
+    finally:
+        server.close()
+
+
+def test_engine_rejects_malformed_queries(rng, tmp_path):
+    g_ref, model, _ = _fit(tmp_path, rng, n=12, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine).start()
+    try:
+        with pytest.raises(ValueError, match="dosage vector"):
+            server.submit(np.zeros(100, np.int8))  # wrong variant count
+        with pytest.raises(ValueError):
+            engine.project_batch(
+                np.zeros((3, 256), np.int8))  # over max_batch
+        # wrong-panel engine construction fails before staging
+        with pytest.raises(ValueError, match="fitted on"):
+            ProjectionEngine(model, ArraySource(g_ref[:6]),
+                             block_variants=BV)
+    finally:
+        server.close()
+
+
+def test_serve_cli_loadgen_mode(rng, tmp_path, capsys):
+    """The `serve --loadgen` CLI path end to end, with telemetry export:
+    pack a panel, fit a model, serve it, and read the report + exported
+    serve.* histograms."""
+    from spark_examples_tpu.cli.main import main
+    from spark_examples_tpu.ingest.packed import save_packed
+
+    g_ref = random_genotypes(rng, n=16, v=256, missing_rate=0.1)
+    store = str(tmp_path / "panel_store")
+    save_packed(store, g_ref, bits=2)
+    model = str(tmp_path / "cli_model.npz")
+    tdir = str(tmp_path / "cli_tel")
+    assert main(["pcoa", "--source", "packed", "--path", store,
+                 "--num-pc", "3", "--block-variants", str(BV),
+                 "--save-model", model]) == 0
+    telemetry.reset()
+    assert main(["serve", "--model", model,
+                 "--ref-source", "packed", "--ref-path", store,
+                 "--source", "synthetic", "--n-samples", "8",
+                 "--block-variants", str(BV),
+                 "--max-batch", "4", "--max-linger-ms", "1",
+                 "--loadgen", "2", "--loadgen-requests", "6",
+                 "--telemetry-dir", tdir]) == 0
+    out = capsys.readouterr().out
+    report = json.loads(out.strip().splitlines()[-1])
+    assert report["completed"] == 12 and report["errors"] == 0
+    assert report["sustained_qps"] > 0
+    with open(tmp_path / "cli_tel" / "rank0" / "metrics.json") as f:
+        exported = json.load(f)
+    assert exported["histograms"]["serve.latency_s"]["count"] > 0
+    telemetry.configure(dir=None)

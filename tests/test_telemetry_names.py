@@ -81,6 +81,19 @@ def test_core_names_present():
         "hard_sync.fallback",
         "stream.snapshot",
         "phase.*",
+        # serving subsystem (registered from day one — the satellite)
+        "serve.latency_s",
+        "serve.enqueue_wait_s",
+        "serve.batch_rows",
+        "serve.device_step",
+        "serve.assemble",
+        "serve.drain",
+        "serve.shed",
+        "serve.requests",
+        "serve.cache_hits",
+        "serve.cache_misses",
+        "serve.deadline_expired",
+        "serve.in_flight",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution
