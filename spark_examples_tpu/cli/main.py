@@ -30,14 +30,50 @@ from spark_examples_tpu.core.config import (
 )
 
 
+_SOURCES = ("synthetic", "vcf", "packed", "plink", "parquet", "store")
+
+
+def _source_arg(value: str) -> str:
+    """A source name, or the one-flag store form ``store:<dir>`` — an
+    argparse ``type`` instead of ``choices`` so the parameterized form
+    validates without enumerating every possible directory. The ':'
+    spelling is the STORE's only (other sources take --path), and an
+    empty dir is rejected here so both mistakes die as usage errors,
+    not mid-job tracebacks."""
+    base, sep, rest = value.partition(":")
+    if base not in _SOURCES or (sep and base != "store"):
+        raise argparse.ArgumentTypeError(
+            f"invalid source {value!r} (choose from "
+            f"{', '.join(_SOURCES)}, or store:<dir>; other sources "
+            "take --path)"
+        )
+    if sep and not rest:
+        raise argparse.ArgumentTypeError(
+            "bad source 'store:': expected store:<dir> (the compacted "
+            "store directory)"
+        )
+    return value
+
+
+def _needs_ref_path(args) -> bool:
+    """Whether --ref-path is still required: synthetic generates its
+    panel and store:<dir> carries the path in the source spec."""
+    return (not args.ref_path and args.ref_source != "synthetic"
+            and not args.ref_source.startswith("store:"))
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("ingest")
-    g.add_argument("--source", default="synthetic",
-                   choices=["synthetic", "vcf", "packed", "plink", "parquet"])
+    g.add_argument("--source", default="synthetic", type=_source_arg,
+                   metavar="{" + ",".join(_SOURCES) + "}",
+                   help="genotype source; 'store' is the content-"
+                   "addressed dataset store (compact one with the "
+                   "`ingest` subcommand), also spellable store:<dir>")
     g.add_argument("--path", default=None,
                    help="input for vcf (.vcf/.vcf.gz), packed (store "
-                   "dir), plink (fileset prefix or .bed path), or "
-                   "parquet (.parquet variant table) sources")
+                   "dir), plink (fileset prefix or .bed path), "
+                   "parquet (.parquet variant table), or store "
+                   "(compacted store dir) sources")
     g.add_argument("--references", nargs="*", default=[],
                    metavar="CONTIG:START:END",
                    help="genomic ranges to ingest (VCF region filter)")
@@ -80,6 +116,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     g.add_argument("--io-retry-backoff", type=float, default=0.05,
                    help="initial retry backoff in seconds (exponential "
                    "with jitter)")
+    g.add_argument("--store-cache-mb", type=int, default=256,
+                   help="host-RAM budget of the dataset store's decode "
+                   "cache (dense chunk decodes, LRU with hit/miss "
+                   "accounting; 0 disables — see README 'Dataset "
+                   "store')")
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
@@ -176,6 +217,7 @@ def _job_from_args(args) -> JobConfig:
             prefetch_blocks=args.prefetch_blocks,
             io_retries=args.io_retries,
             io_retry_backoff_s=args.io_retry_backoff,
+            store_cache_mb=args.store_cache_mb,
         ),
         compute=ComputeConfig(
             backend=args.backend,
@@ -257,10 +299,11 @@ def main(argv: list[str] | None = None) -> int:
     p_proj.add_argument("--model", required=True,
                         help=".npz from pcoa --save-model")
     p_proj.add_argument("--ref-source", default="plink",
-                        choices=["synthetic", "vcf", "packed", "plink",
-                                 "parquet"],
+                        type=_source_arg,
+                        metavar="{" + ",".join(_SOURCES) + "}",
                         help="reference cohort genotypes (the panel the "
-                        "model was fitted on)")
+                        "model was fitted on); store:<dir> works here "
+                        "too")
     p_proj.add_argument("--ref-path", default=None)
 
     p_srv = sub.add_parser(
@@ -276,10 +319,11 @@ def main(argv: list[str] | None = None) -> int:
     p_srv.add_argument("--model", required=True,
                        help=".npz from pcoa/pca --save-model")
     p_srv.add_argument("--ref-source", default="packed",
-                       choices=["synthetic", "vcf", "packed", "plink",
-                                "parquet"],
+                       type=_source_arg,
+                       metavar="{" + ",".join(_SOURCES) + "}",
                        help="reference panel genotypes (the panel the "
-                       "model was fitted on) — staged to device once")
+                       "model was fitted on) — staged to device once; "
+                       "store:<dir> works here too")
     p_srv.add_argument("--ref-path", default=None)
     p_srv.add_argument("--max-batch", type=int,
                        default=config.ServeConfig.max_batch,
@@ -320,8 +364,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     _add_common(p_ck)  # --source/--path describe the NEW cohort
     p_ck.add_argument("--ref-source", default="plink",
-                      choices=["synthetic", "vcf", "packed", "plink",
-                               "parquet"])
+                      type=_source_arg,
+                      metavar="{" + ",".join(_SOURCES) + "}")
     p_ck.add_argument("--ref-path", default=None)
     p_ck.add_argument("--min-phi", type=float, default=0.177,
                       help="console report threshold (0.177 ~ the "
@@ -334,6 +378,22 @@ def main(argv: list[str] | None = None) -> int:
         "(parse once; later jobs read zero-copy packed bytes)",
     )
     _add_common(p_pack)
+
+    p_ing = sub.add_parser(
+        "ingest",
+        help="compact any source ONCE into the content-addressed "
+        "dataset store: 2-bit packed sha256-named chunk files + a JSON "
+        "manifest (catalog: sample ids, contig/position index, "
+        "per-chunk digests). Every later job reads it with "
+        "--source store:<dir> — mmap zero-copy, range queries, "
+        "verified reads",
+    )
+    _add_common(p_ing)
+    p_ing.add_argument("--chunk-variants", type=int, default=16384,
+                       help="catalog granularity: variants per chunk "
+                       "file (the unit of range addressing, integrity "
+                       "verification, and decode caching; must be a "
+                       "multiple of 4)")
 
     p_cov = sub.add_parser("coverage",
                            help="per-base read coverage over ranges "
@@ -533,7 +593,7 @@ def _dispatch(args, parser, job, J, build_source) -> int:
 
         from spark_examples_tpu.pipelines.project import cross_kinship_job
 
-        if not args.ref_path and args.ref_source != "synthetic":
+        if _needs_ref_path(args):
             parser.error("cross-kinship requires --ref-path")
         if args.maf > 0.0 or args.max_missing < 1.0 or args.ld_prune_r2 > 0:
             parser.error(
@@ -569,7 +629,7 @@ def _dispatch(args, parser, job, J, build_source) -> int:
 
         from spark_examples_tpu.pipelines.project import pcoa_project_job
 
-        if not args.ref_path and args.ref_source != "synthetic":
+        if _needs_ref_path(args):
             parser.error("project requires --ref-path (the panel "
                          "genotypes the model was fitted on)")
         if args.maf > 0.0 or args.max_missing < 1.0 or args.ld_prune_r2 > 0.0:
@@ -611,6 +671,29 @@ def _dispatch(args, parser, job, J, build_source) -> int:
             f"{job.output_path} in {dt:.1f}s"
         )
         return 0
+    elif args.command == "ingest":
+        import time as _time
+
+        from spark_examples_tpu.store import compact
+
+        if not job.output_path:
+            parser.error("ingest requires --output-path (the store "
+                         "directory to compact into)")
+        src = build_source(job.ingest)
+        t0 = _time.perf_counter()
+        manifest = compact(job.output_path, src,
+                           chunk_variants=args.chunk_variants)
+        dt = _time.perf_counter() - t0
+        dense_mb = manifest.n_samples * manifest.n_variants / 1e6
+        print(
+            f"compacted {manifest.n_samples} samples x "
+            f"{manifest.n_variants} variants into {len(manifest.chunks)} "
+            f"content-addressed chunks ({dense_mb / 4:.1f} MB 2-bit) -> "
+            f"{job.output_path} in {dt:.1f}s "
+            f"({dense_mb / max(dt, 1e-9):.0f} MB/s dense-equivalent); "
+            f"read it back with --source store:{job.output_path}"
+        )
+        return 0
     else:  # pragma: no cover
         parser.error(f"unknown command {args.command}")
 
@@ -631,7 +714,7 @@ def _run_serve(args, parser, job, build_source) -> int:
         ProjectionEngine, ProjectionServer, run_loadgen,
     )
 
-    if not args.ref_path and args.ref_source != "synthetic":
+    if _needs_ref_path(args):
         parser.error("serve requires --ref-path (the panel genotypes "
                      "the model was fitted on)")
     cfg = config.ServeConfig(

@@ -43,7 +43,6 @@ both generations fail verification does load raise
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import shutil
@@ -54,42 +53,20 @@ import numpy as np
 
 from spark_examples_tpu.core import faults, telemetry
 
+# Shared digest vocabulary (core/hashing.py) — the store's content
+# addressing and this module's file integrity use the SAME encodings.
+from spark_examples_tpu.core.hashing import (
+    TeeHashWriter as _TeeHashWriter,
+    sample_hash as _sample_hash,
+    sha256_file as _sha256_file,
+)
+
 
 class CheckpointCorruptError(RuntimeError):
     """Every on-disk generation failed checksum verification. Raised
     (not silently ignored): restarting from zero discards work the
     operator may be able to recover; delete the checkpoint directory to
     restart deliberately."""
-
-
-def _sha256_file(path: str) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
-
-
-class _TeeHashWriter:
-    """File wrapper hashing every byte as np.save writes it — the save
-    path must not re-read what it just wrote just to checksum it (that
-    would double every checkpoint's IO over a shared filesystem)."""
-
-    def __init__(self, f):
-        self._f = f
-        self.sha256 = hashlib.sha256()
-
-    def write(self, data):
-        self.sha256.update(data)
-        return self._f.write(data)
-
-    def __getattr__(self, name):
-        return getattr(self._f, name)
-
-
-def _sample_hash(sample_ids: list[str]) -> str:
-    h = hashlib.sha256("\n".join(sample_ids).encode()).hexdigest()
-    return h[:16]
 
 
 def _is_replicated(v) -> bool:

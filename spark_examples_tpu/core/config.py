@@ -58,8 +58,11 @@ class ReferenceRange:
 class IngestConfig:
     """Which variants to stream, from where, in what block shape."""
 
-    source: str = "synthetic"  # synthetic | vcf | packed | plink | parquet
-    path: str | None = None  # file path for vcf/packed sources
+    # synthetic | vcf | packed | plink | parquet | store. The shorthand
+    # "store:<dir>" (accepted everywhere a source is) is normalized in
+    # __post_init__ into source="store", path="<dir>".
+    source: str = "synthetic"
+    path: str | None = None  # file path for vcf/packed/store sources
     references: list[ReferenceRange] = field(default_factory=list)
     n_samples: int = 2504  # synthetic default: 1000 Genomes phase-3 cohort
     n_variants: int = 100_000  # synthetic default
@@ -100,6 +103,29 @@ class IngestConfig:
     ld_r2: float = 0.0
     ld_window: int = 256
     ld_carry: int = 0  # 0 = auto (window // 4)
+    # Dataset-store read path (spark_examples_tpu/store): host-RAM
+    # budget of the bounded decode cache (dense chunk decodes; tier 2
+    # of mmap -> cache -> consumer). 0 disables caching.
+    store_cache_mb: int = 256
+
+    def __post_init__(self):
+        # `--source store:<dir>` — the one-flag spelling of the
+        # content-addressed store, accepted everywhere a source is.
+        if self.source.startswith("store:"):
+            spec_path = self.source.split(":", 1)[1]
+            if self.path:
+                raise ValueError(
+                    f"ambiguous ingest: source {self.source!r} names a "
+                    f"store directory AND path={self.path!r} is set — "
+                    "use one or the other"
+                )
+            if not spec_path:
+                raise ValueError(
+                    "bad source 'store:': expected store:<dir> (the "
+                    "compacted store directory)"
+                )
+            self.source = "store"
+            self.path = spec_path
 
 
 @dataclass

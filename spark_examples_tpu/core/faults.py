@@ -43,6 +43,13 @@ Sites instrumented in production code:
                             request, ``delay`` stalls the worker so the
                             bounded admission queue must shed, ``kill``
                             simulates a serving-process preemption
+``store.read``              per chunk read in the content-addressed
+                            block store (store/reader.py), fired with
+                            the chunk file path BEFORE the bytes are
+                            mapped — ``io_error`` exercises the
+                            RetryingSource boundary, ``truncate``
+                            corrupts the chunk against its recorded
+                            digest (quarantine must catch it)
 ==========================  ====================================================
 
 Env grammar (``;``-separated specs, ``:``-separated fields)::
@@ -80,6 +87,7 @@ SITES = (
     "multihost.consensus",
     "device.put",
     "serve.request",
+    "store.read",
 )
 
 # Distinctive exit code for the "kill" kind so tests can tell an injected

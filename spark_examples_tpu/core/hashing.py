@@ -1,0 +1,71 @@
+"""Shared content-hashing helpers — ONE sha256 vocabulary for the repo.
+
+Three subsystems independently grew digest code: the checkpoint layer
+(per-file integrity + a tee writer that hashes bytes as np.save emits
+them), the serving result cache (genotype-block content keys), and now
+the content-addressed dataset store (chunk addresses ARE digests). The
+helpers live here so the encodings can't drift: a digest computed at
+write time by one subsystem must verify at read time in another.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def sha256_bytes(data) -> str:
+    """Hex sha256 of a bytes-like object (bytes/memoryview/buffer).
+
+    The store's chunk content address: the digest of the packed chunk
+    bytes exactly as they land on disk, so filename == content and a
+    re-read can be verified against the name alone.
+    """
+    return hashlib.sha256(data).hexdigest()
+
+
+def sha256_file(path: str, chunk_bytes: int = 1 << 20) -> str:
+    """Hex sha256 of a file, streamed (never the whole file in RAM)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(chunk_bytes), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class TeeHashWriter:
+    """File wrapper hashing every byte as it is written — save paths
+    must not re-read what they just wrote only to checksum it (that
+    would double every checkpoint/compaction's IO over a shared
+    filesystem)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha256 = hashlib.sha256()
+
+    def write(self, data):
+        self.sha256.update(data)
+        return self._f.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def array_digest(arr: np.ndarray, namespace: str = "") -> str:
+    """Content digest of one array, dtype and shape folded in so two
+    buffers with the same bytes but different views cannot collide;
+    ``namespace`` prefixes a caller-chosen scope (e.g. the serving
+    cache's model fingerprint)."""
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(f"{namespace}|{a.dtype.str}|{a.shape}|".encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def sample_hash(sample_ids: list[str]) -> str:
+    """Short (16-hex) cohort fingerprint over the ordered sample ids —
+    the compatibility check checkpoint and store manifests both carry."""
+    h = hashlib.sha256("\n".join(sample_ids).encode()).hexdigest()
+    return h[:16]

@@ -1,0 +1,67 @@
+"""Versioned-JSON-sidecar loading — ONE validation ladder for the repo.
+
+Both on-disk catalogs (the packed store's ``meta.json``, the dataset
+store's ``manifest.json``) carry a ``schema_version`` and the same
+failure modes: file missing, unreadable/truncated JSON, a
+pre-versioning file, a file from a newer build, a required field
+absent. The friendly-error ladder (mirroring ``load_model()``'s
+``ModelFormatError`` treatment) lives here once so the wording, the
+version policy, and the next schema migration cannot drift between
+them.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def load_versioned_sidecar(
+    path: str,
+    *,
+    current_version: int,
+    required: tuple,
+    error_cls: type,
+    noun: str,
+    missing_msg: str,
+    repair: str,
+) -> dict:
+    """Load + validate a versioned JSON sidecar, raising ``error_cls``
+    with the cause named on every unusable file.
+
+    ``noun`` describes the file in errors (e.g. "store manifest");
+    ``missing_msg`` is the full FileNotFoundError message (the one case
+    whose phrasing is site-specific); ``repair`` is the recovery verb
+    phrase (e.g. "re-pack the store"). Returns the parsed dict with
+    ``schema_version`` guaranteed present, an int, and <= current.
+    """
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except FileNotFoundError:
+        raise error_cls(missing_msg) from None
+    except (OSError, ValueError) as e:
+        raise error_cls(
+            f"{noun} {path!r} is unreadable ({e}) — truncated or "
+            f"corrupt? {repair}"
+        ) from None
+    if "schema_version" not in raw:
+        raise error_cls(
+            f"{noun} {path!r} has no 'schema_version' field — written "
+            f"by a pre-versioning build; {repair} to upgrade"
+        )
+    version = int(raw["schema_version"])
+    raw["schema_version"] = version
+    if version > current_version:
+        raise error_cls(
+            f"{noun} {path!r} has schema_version {version}, newer than "
+            f"this build's {current_version} — upgrade the code or "
+            f"{repair} with this version"
+        )
+    missing = [k for k in required if k not in raw]
+    if missing:
+        raise error_cls(
+            f"{noun} {path!r} (schema_version {version}) is missing "
+            f"required field(s) {missing} — truncated or hand-edited? "
+            f"{repair}"
+        )
+    return raw

@@ -100,6 +100,17 @@ NAMES: dict[str, tuple[str, str]] = {
         "graceful server drain: admission closed, wall-clock until every "
         "in-flight request resolved and the worker joined",
     ),
+    "store.compact": (
+        "span",
+        "one compaction pass of the content-addressed block store: "
+        "source stream -> packed sha256-named chunk files + manifest "
+        "(duration vs store.compact_bytes = compaction throughput)",
+    ),
+    "store.chunk_read": (
+        "span",
+        "one chunk through the store read path: fault site + mmap + "
+        "first-touch digest verify + 2-bit decode (or decode-cache hit)",
+    ),
     # -- instant events ---------------------------------------------------
     "fault": ("event", "a fault-injection spec fired (args: site, kind)"),
     "stream.snapshot": (
@@ -170,6 +181,42 @@ NAMES: dict[str, tuple[str, str]] = {
         "admitted requests answered with a processing error (including "
         "injected serve.request faults)",
     ),
+    "store.compact_bytes": (
+        "counter",
+        "packed chunk bytes written by the compaction writer (a chunk "
+        "deduplicated by content address is counted once, when written)",
+    ),
+    "store.compact_chunks": (
+        "counter",
+        "chunks the compaction writer emitted (including deduplicated "
+        "ones — the manifest records every chunk, shared files or not)",
+    ),
+    "store.cache_hits": (
+        "counter",
+        "store reads answered from the bounded host-RAM decode cache "
+        "(no mmap touch, no 2-bit decode)",
+    ),
+    "store.cache_misses": (
+        "counter",
+        "store reads that mapped + decoded a chunk from disk (the cold "
+        "tier); hits / (hits + misses) is the cache hit rate",
+    ),
+    "store.chunks_verified": (
+        "counter",
+        "chunk digests re-hashed against the manifest on first touch "
+        "(each chunk verifies once per reader, not once per read)",
+    ),
+    "store.verify_failures": (
+        "counter",
+        "chunks whose bytes no longer match their content address — "
+        "every one is quarantined and the read fails fast with the "
+        "resume cursor named",
+    ),
+    "store.quarantined": (
+        "counter",
+        "corrupt chunks recorded in the store's quarantine.json (the "
+        "operator-facing recovery list; never silently skipped)",
+    ),
     # -- gauges -----------------------------------------------------------
     "prefetch.queue_depth": (
         "gauge",
@@ -181,6 +228,12 @@ NAMES: dict[str, tuple[str, str]] = {
         "gauge",
         "admitted-but-unanswered requests in the projection server "
         "(queued + in the current batch); max is the realized backlog",
+    ),
+    "store.cache_bytes": (
+        "gauge",
+        "decoded dense bytes resident in the store's host-RAM decode "
+        "cache (bounded by --store-cache-mb; max == the bound means "
+        "the working set does not fit and evictions are live)",
     ),
     # -- histograms -------------------------------------------------------
     "prefetch.put_wait_s": (

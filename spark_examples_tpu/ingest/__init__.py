@@ -13,7 +13,9 @@ from spark_examples_tpu.ingest.plink import (  # noqa: F401
     write_plink,
 )
 from spark_examples_tpu.ingest.packed import (  # noqa: F401
+    PACKED_SCHEMA_VERSION,
     Packed2BitSource,
+    PackedFormatError,
     load_packed,
     save_packed,
 )

@@ -29,6 +29,23 @@ from spark_examples_tpu.core.dtypes import GENOTYPE_DTYPE
 from spark_examples_tpu.ingest import bitpack
 from spark_examples_tpu.ingest.source import ArraySource, BlockMeta
 
+# Sidecar schema version, mirroring the saved-model treatment
+# (pipelines/project.py SCHEMA_VERSION): bump when a field is added/
+# renamed/re-semanticized; load_packed refuses files it cannot
+# interpret with a friendly error instead of a raw KeyError. Version 2
+# = the first versioned schema (version 1, retroactively, is the
+# unversioned pre-versioning format).
+PACKED_SCHEMA_VERSION = 2
+
+_REQUIRED_META = ("n_samples", "n_variants", "bits")
+
+
+class PackedFormatError(ValueError):
+    """A packed-store sidecar that cannot be safely interpreted:
+    missing/truncated meta.json, a pre-versioning store, a store from a
+    newer build, a missing required field, or a missing genotype file —
+    always with the offending cause named."""
+
 
 def _write_sidecar(
     path: str,
@@ -47,6 +64,7 @@ def _write_sidecar(
     cohorts — run i spans [start_i, start_{i+1}).
     """
     meta = {
+        "schema_version": PACKED_SCHEMA_VERSION,
         "n_samples": int(n_samples),
         "n_variants": int(n_variants),
         "bits": bits,
@@ -271,26 +289,61 @@ def pack_source(
     return written
 
 
+def _load_meta(path: str) -> dict:
+    """The sidecar, validated with load_model()-grade friendliness —
+    every way a long-lived job can trip over a bad store directory gets
+    a :class:`PackedFormatError` naming the cause, never a raw
+    ``KeyError``/``JSONDecodeError``/``FileNotFoundError``. The ladder
+    itself is shared with the dataset-store manifest
+    (core/sidecar.py)."""
+    from spark_examples_tpu.core.sidecar import load_versioned_sidecar
+
+    meta_path = os.path.join(path, "meta.json")
+    return load_versioned_sidecar(
+        meta_path,
+        current_version=PACKED_SCHEMA_VERSION,
+        required=_REQUIRED_META,
+        error_cls=PackedFormatError,
+        noun="packed-store sidecar",
+        missing_msg=(
+            f"{path!r} is not a packed store: no meta.json (create one "
+            "with the `pack` command or save_packed)"
+        ),
+        repair="re-pack the store",
+    )
+
+
 def load_packed(path: str, mmap: bool = True):
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
+    meta = _load_meta(path)
     positions = None
     pos_path = os.path.join(path, "positions.npy")
     if os.path.exists(pos_path):
         positions = np.load(pos_path)
     mode = "r" if mmap else None
-    if meta.get("bits", 8) == 2:
-        p = np.load(os.path.join(path, "genotypes.2bit.npy"), mmap_mode=mode)
+    bits = meta["bits"]
+    fname = "genotypes.2bit.npy" if bits == 2 else "genotypes.npy"
+    try:
+        g = np.load(os.path.join(path, fname), mmap_mode=mode)
+    except FileNotFoundError:
+        raise PackedFormatError(
+            f"packed store {path!r}: sidecar says bits={bits} but "
+            f"{fname} is missing — interrupted pack? re-pack the store"
+        ) from None
+    except ValueError as e:
+        raise PackedFormatError(
+            f"packed store {path!r}: {fname} is not a readable .npy "
+            f"({e}) — truncated or corrupt? re-pack the store"
+        ) from None
+    if bits == 2:
         runs = meta.get("contig_runs")
         return Packed2BitSource(
-            packed=p,
+            packed=g,
             v=meta["n_variants"],
             ids=meta.get("sample_ids"),
             contig=meta.get("contig"),
             positions=positions,
             contig_runs=[(c, int(s)) for c, s in runs] if runs else None,
         )
-    g = np.load(os.path.join(path, "genotypes.npy"), mmap_mode=mode)
     return ArraySource(
         genotypes=g,
         ids=meta.get("sample_ids"),

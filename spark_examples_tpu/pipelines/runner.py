@@ -131,7 +131,7 @@ def _build_local_partition(cfg: IngestConfig):
     )
 
     p, n_proc = jax.process_index(), jax.process_count()
-    if cfg.source in ("vcf", "plink", "parquet") and cfg.references:
+    if cfg.source in ("vcf", "plink", "parquet", "store") and cfg.references:
         mine = []
         for ref in cfg.references:
             parts = partition_ranges([ref], n_proc)
@@ -199,6 +199,27 @@ def _build_raw_source(cfg: IngestConfig):
             raise ValueError("packed source requires ingest.path")
         return _maybe_retrying(load_packed(cfg.path), cfg,
                                reopen=lambda: load_packed(cfg.path))
+    if cfg.source == "store":
+        if not cfg.path:
+            raise ValueError(
+                "store source requires ingest.path (the compacted "
+                "store directory — `ingest --output-path <dir>`), or "
+                "the one-flag form --source store:<dir>"
+            )
+        from spark_examples_tpu.store import open_store
+
+        def _open():
+            src = open_store(cfg.path,
+                             cache_bytes=cfg.store_cache_mb << 20)
+            # --references answered from the catalog's position index
+            # (the range-partitioner surface), no chunk touched.
+            if cfg.references:
+                return src.restrict(cfg.references)
+            return src
+
+        # mmap-backed like the packed store: a retry must rebuild the
+        # mapping, not re-slice a dead one.
+        return _maybe_retrying(_open(), cfg, reopen=_open)
     if cfg.source == "plink":
         if not cfg.path:
             raise ValueError(

@@ -10,11 +10,12 @@ identical queries (retries, duplicate submissions, shared panels).
 
 from __future__ import annotations
 
-import hashlib
 import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from spark_examples_tpu.core.hashing import array_digest
 
 
 def genotype_digest(genotypes: np.ndarray, namespace: str = "") -> str:
@@ -22,12 +23,10 @@ def genotype_digest(genotypes: np.ndarray, namespace: str = "") -> str:
 
     Shape and dtype are folded in so a (V,) int8 query and some other
     buffer with the same bytes cannot collide; ``namespace`` carries the
-    model fingerprint (ProjectionModel.digest())."""
-    g = np.ascontiguousarray(genotypes)
-    h = hashlib.sha256()
-    h.update(f"{namespace}|{g.dtype.str}|{g.shape}|".encode())
-    h.update(g.tobytes())
-    return h.hexdigest()
+    model fingerprint (ProjectionModel.digest()). Delegates to the
+    shared encoding in core/hashing.py (the store and checkpoint layers
+    hash with the same vocabulary)."""
+    return array_digest(genotypes, namespace=namespace)
 
 
 class ResultCache:

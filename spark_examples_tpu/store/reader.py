@@ -1,0 +1,383 @@
+"""StoreSource: the catalog as a GenotypeSource, with verified reads.
+
+The read path is tiered:
+
+1. **disk** — each chunk file is ``np.memmap``-ed read-only (zero-copy:
+   the packed bytes page in on demand and a packed-transport consumer
+   ships slices of the mapping straight to ``device_put``);
+2. **decode cache** — dense int8 decodes of hot chunks, bounded host
+   RAM with hit/miss accounting (store/cache.py);
+3. the consumer: ``blocks`` / ``packed_blocks`` re-grid chunks into any
+   requested block width (never spanning a contig), ``range_source``
+   answers contig/variant/position range queries off the catalog, and
+   cursors resume deterministically — the drop-in contract every job
+   surface (runner, streaming, serve staging) already assumes.
+
+**Integrity**: a chunk's filename is its sha256. On first touch per
+reader the bytes are re-hashed against the address (``store.read``
+fault site fires first, so the chaos harness can corrupt or fail the
+read deterministically). A mismatch or truncation is quarantined —
+recorded in ``<store>/quarantine.json``, counted, and raised as
+:class:`StoreCorruptError` naming the resume cursor. Corruption is
+damage, not weather: the retry layer (ingest/resilient.py) retries
+transient ``IOError`` s around this path but never a quarantined chunk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from dataclasses import replace as _dc_replace
+
+import numpy as np
+
+from spark_examples_tpu.core import faults, hashing, telemetry
+from spark_examples_tpu.ingest import bitpack
+from spark_examples_tpu.ingest.source import BlockMeta
+from spark_examples_tpu.store.cache import DecodeCache
+from spark_examples_tpu.store.manifest import (
+    QUARANTINE_NAME,
+    ChunkRecord,
+    StoreCorruptError,
+    StoreManifest,
+)
+
+DEFAULT_CACHE_BYTES = 256 << 20  # 256 MB of decoded chunks
+
+
+def open_store(path: str, cache_bytes: int = DEFAULT_CACHE_BYTES,
+               verify: bool = True) -> "StoreSource":
+    """Open a compacted store (manifest load + lazy chunk mapping)."""
+    return StoreSource(path, StoreManifest.load(path),
+                       cache_bytes=cache_bytes, verify=verify)
+
+
+class StoreSource:
+    """A compacted store as a streaming genotype source (see module
+    docstring). Construct via :func:`open_store`."""
+
+    def __init__(self, root: str, manifest: StoreManifest,
+                 cache_bytes: int = DEFAULT_CACHE_BYTES,
+                 verify: bool = True):
+        self.root = root
+        self.manifest = manifest
+        self.verify = bool(verify)
+        self.cache = DecodeCache(cache_bytes)
+        self._verified: set[int] = set()
+        self._positions: np.ndarray | None = None
+
+    # -- GenotypeSource metadata -------------------------------------------
+
+    @property
+    def n_samples(self) -> int:
+        return self.manifest.n_samples
+
+    @property
+    def n_variants(self) -> int:
+        return self.manifest.n_variants
+
+    @property
+    def sample_ids(self) -> list[str]:
+        if self.manifest.sample_ids is not None:
+            return self.manifest.sample_ids
+        return [f"S{i:06d}" for i in range(self.n_samples)]
+
+    @property
+    def exact_n_variants(self) -> bool:
+        """Same claim shape as Packed2BitSource: a single-contig store
+        streams exactly ceil(V/bv) blocks on both transports; a multi-
+        contig store's blocks flush at chromosome runs, so it declines."""
+        return len(self.manifest.contig_runs) <= 1
+
+    @property
+    def positions(self) -> np.ndarray | None:
+        """Per-variant positions (mmap), digest-verified on first load."""
+        if not self.manifest.has_positions:
+            return None
+        if self._positions is None:
+            pos_path = os.path.join(self.root, "positions.npy")
+            want = self.manifest.positions_digest
+            if self.verify and want is not None:
+                got = hashing.sha256_file(pos_path)
+                if got != want:
+                    raise StoreCorruptError(
+                        f"store positions file {pos_path!r} does not "
+                        f"match its manifest digest (truncated or "
+                        "corrupt) — re-compact the store", 0,
+                    )
+            self._positions = np.load(pos_path, mmap_mode="r")
+        return self._positions
+
+    # -- chunk access (the tiered read path) -------------------------------
+
+    def _chunk_path(self, rec: ChunkRecord) -> str:
+        return os.path.join(self.root, rec.filename())
+
+    def _quarantine(self, idx: int, rec: ChunkRecord, reason: str):
+        """Record a corrupt chunk and fail fast with the cursor named.
+
+        The file is left in place (the operator may be able to recover
+        it — e.g. re-copy from a replica; content addressing means a
+        recovered chunk needs no manifest surgery), but its address is
+        appended to quarantine.json so post-mortem tooling sees every
+        incident even after the process dies."""
+        telemetry.count("store.verify_failures")
+        telemetry.count("store.quarantined")
+        qpath = os.path.join(self.root, QUARANTINE_NAME)
+        entry = {"chunk": idx, "digest": rec.digest,
+                 "file": rec.filename(), "start": rec.start,
+                 "stop": rec.stop, "reason": reason}
+        try:
+            existing = []
+            if os.path.exists(qpath):
+                with open(qpath) as f:
+                    existing = json.load(f)
+            if not any(e.get("digest") == rec.digest for e in existing):
+                existing.append(entry)
+                tmp = qpath + f".tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(existing, f)
+                os.replace(tmp, qpath)
+        except (OSError, ValueError) as e:
+            warnings.warn(
+                f"store: could not record quarantined chunk in {qpath} "
+                f"({e}) — the corruption error below still stands",
+                RuntimeWarning, stacklevel=3,
+            )
+        raise StoreCorruptError(
+            f"store chunk {idx} ({rec.filename()}, variants "
+            f"[{rec.start}, {rec.stop})) is corrupt: {reason} — the "
+            "chunk is quarantined (see quarantine.json), not retried "
+            "and not skipped; recover the file (its name is its "
+            "expected sha256 — restore it from a replica, or delete it "
+            "and re-run the compaction over the original source) and "
+            f"resume from start_variant={rec.start} (or the last "
+            "--checkpoint-dir checkpoint)",
+            rec.start,
+        )
+
+    def _chunk_bytes(self, idx: int) -> np.ndarray:
+        """The chunk's packed bytes, mapped and (first touch) verified."""
+        rec = self.manifest.chunks[idx]
+        path = self._chunk_path(rec)
+        # Chaos site BEFORE the mapping: an armed truncate corrupts the
+        # file relative to its content address (exactly what a torn
+        # replica copy looks like); an io_error exercises the retry
+        # boundary wrapping this source.
+        faults.fire("store.read", path=path)
+        w_bytes = bitpack.packed_width(rec.width)
+        try:
+            m = np.memmap(path, dtype=np.uint8, mode="r",
+                          shape=(self.n_samples, w_bytes))
+        except ValueError as e:
+            # Wrong file size for the catalog shape = truncation.
+            self._quarantine(idx, rec, f"wrong size for "
+                            f"({self.n_samples}, {w_bytes}) bytes ({e})")
+        except FileNotFoundError:
+            # A cataloged chunk that does not exist is damage (a lost
+            # replica copy, a deleted quarantined file), not weather —
+            # letting it escape as raw OSError would burn the retry
+            # layer's whole reopen budget re-missing the same file and
+            # end with no recovery guidance. Other OSErrors (EIO, a
+            # flapping mount) stay retryable.
+            self._quarantine(idx, rec, "chunk file missing")
+        if self.verify and idx not in self._verified:
+            got = hashing.sha256_bytes(m)
+            telemetry.count("store.chunks_verified")
+            if got != rec.digest:
+                self._quarantine(
+                    idx, rec, f"sha256 {got[:16]}... does not match the "
+                    "content address (bit rot or a torn write)")
+            self._verified.add(idx)
+        return m
+
+    def _chunk_dense(self, idx: int) -> np.ndarray:
+        """Dense int8 decode of one chunk, through the decode cache."""
+        cached = self.cache.get(idx)
+        if cached is not None:
+            return cached
+        rec = self.manifest.chunks[idx]
+        with telemetry.span("store.chunk_read", cat="store", chunk=idx):
+            raw = self._chunk_bytes(idx)
+            dense = bitpack.unpack_dosages_np(raw)[:, :rec.width]
+        self.cache.put(idx, dense)
+        return dense
+
+    def read_range(self, lo: int, hi: int) -> np.ndarray:
+        """Dense (N, hi-lo) int8 slice of the global variant order —
+        the random-access primitive range queries and tests build on."""
+        if not 0 <= lo <= hi <= self.n_variants:
+            raise ValueError(
+                f"variant range [{lo}, {hi}) out of bounds for a "
+                f"{self.n_variants}-variant store"
+            )
+        parts = [
+            self._chunk_dense(i)[:, max(lo - rec.start, 0):hi - rec.start]
+            for i, rec in self.manifest.chunks_for_range(lo, hi)
+        ]
+        if not parts:
+            return np.empty((self.n_samples, 0), np.int8)
+        out = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+        return np.ascontiguousarray(out)
+
+    # -- streaming transports ----------------------------------------------
+
+    def _grid(self, block_variants: int):
+        """(idx, lo, hi, contig) for every block of the store's grid:
+        per-contig-segment, restarting at each run boundary (the same
+        geometry VCF/PLINK streams produce, so contigs stay exact)."""
+        bounds = self.manifest.segment_bounds()
+        runs = self.manifest.contig_runs
+        idx = 0
+        for s in range(len(bounds) - 1):
+            contig = runs[s][0]
+            for lo in range(bounds[s], bounds[s + 1], block_variants):
+                hi = min(lo + block_variants, bounds[s + 1])
+                yield idx, lo, hi, contig
+                idx += 1
+
+    def _meta(self, idx, lo, hi, contig) -> BlockMeta:
+        pos = self.positions
+        return BlockMeta(idx, lo, hi, contig,
+                         pos[lo:hi] if pos is not None else None)
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        """Dense blocks at any width; resume skips blocks starting
+        before the cursor (ceil-align for mid-block cursors, exact for
+        self-produced stops — the contract every geometry here keeps)."""
+        for idx, lo, hi, contig in self._grid(block_variants):
+            if lo < start_variant:
+                continue
+            yield self.read_range(lo, hi), self._meta(idx, lo, hi, contig)
+
+    def packed_blocks(self, block_variants: int, start_variant: int = 0):
+        """2-bit packed blocks for the packed transport. Zero-copy when
+        a block falls inside one chunk on the byte grid (the common
+        case: bv dividing chunk_variants); re-packed from the dense
+        decode otherwise — same bytes semantics either way (tail pad
+        codes are MISSING, free to every gram piece)."""
+        if block_variants % bitpack.VARIANTS_PER_BYTE:
+            raise ValueError(
+                f"packed_blocks needs block_variants divisible by "
+                f"{bitpack.VARIANTS_PER_BYTE}, got {block_variants}"
+            )
+        vpb = bitpack.VARIANTS_PER_BYTE
+        for idx, lo, hi, contig in self._grid(block_variants):
+            if lo < start_variant:
+                continue
+            covering = self.manifest.chunks_for_range(lo, hi)
+            if len(covering) == 1 and (lo - covering[0][1].start) % vpb == 0:
+                i, rec = covering[0]
+                raw = self._chunk_bytes(i)
+                b0 = (lo - rec.start) // vpb
+                b1 = bitpack.packed_width(hi - rec.start)
+                pblock = np.ascontiguousarray(raw[:, b0:b1])
+            else:
+                pblock = bitpack.pack_dosages(self.read_range(lo, hi))
+            yield pblock, self._meta(idx, lo, hi, contig)
+
+    # -- range queries (the catalog's partitioner surface) -----------------
+
+    def variant_range(self, lo: int, hi: int) -> "StoreRangeSource":
+        """A GenotypeSource over global variants [lo, hi) — arbitrary
+        bounds, chunk- and block-grid independent."""
+        return StoreRangeSource(self, lo, hi)
+
+    def contig_source(self, contig: str) -> "StoreRangeSource":
+        lo, hi = self.manifest.contig_span(contig)
+        return StoreRangeSource(self, lo, hi)
+
+    def position_span(self, contig: str, start: int, end: int) -> tuple[int, int]:
+        """Global variant range covering positions [start, end) on
+        ``contig`` — the reference's ``searchVariants`` range semantics,
+        answered from the catalog + position index without touching a
+        single chunk. Empty span when nothing matches."""
+        lo, hi = self.manifest.contig_span(contig)
+        if hi <= lo:
+            return 0, 0
+        pos = self.positions
+        if pos is None:
+            raise ValueError(
+                "this store was compacted from a source without "
+                "positions — position-range queries need them; "
+                "variant_range/contig_source still work"
+            )
+        seg = pos[lo:hi]
+        a = lo + int(np.searchsorted(seg, start, side="left"))
+        b = lo + int(np.searchsorted(seg, end, side="left"))
+        return a, b
+
+    def restrict(self, references) -> object:
+        """The ``--references CONTIG:START:END`` filter over the store:
+        one range source per reference, chained in order — the catalog
+        analog of the reference fork's genomic-range partitioners."""
+        from spark_examples_tpu.ingest.source import ChainSource, EmptyShare
+
+        parts = []
+        for ref in references:
+            lo, hi = self.position_span(ref.contig, ref.start, ref.end)
+            if hi > lo:
+                parts.append(StoreRangeSource(self, lo, hi))
+        if not parts:
+            return EmptyShare(self)
+        if len(parts) == 1:
+            return parts[0]
+        return ChainSource(parts)
+
+
+class StoreRangeSource:
+    """A contiguous global-variant window [lo, hi) of a store, with
+    LOCAL indexing — the unit a range query returns. Unlike
+    ``WindowSource`` it accepts arbitrary (unaligned) bounds: the store
+    decodes at chunk granularity anyway, so re-gridding from ``lo`` is
+    free. Blocks still never span a contig run."""
+
+    def __init__(self, store: StoreSource, lo: int, hi: int):
+        if not 0 <= lo <= hi <= store.n_variants:
+            raise ValueError(
+                f"range [{lo}, {hi}) out of bounds for a "
+                f"{store.n_variants}-variant store"
+            )
+        self.store = store
+        self.lo = lo
+        self.hi = hi
+
+    @property
+    def n_samples(self) -> int:
+        return self.store.n_samples
+
+    @property
+    def n_variants(self) -> int:
+        return self.hi - self.lo
+
+    @property
+    def sample_ids(self) -> list[str]:
+        return self.store.sample_ids
+
+    @property
+    def exact_n_variants(self) -> bool:
+        bounds = self.store.manifest.segment_bounds()
+        inner = [b for b in bounds if self.lo < b < self.hi]
+        return not inner
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        bounds = self.store.manifest.segment_bounds()
+        runs = self.store.manifest.contig_runs
+        idx = 0
+        for s in range(len(bounds) - 1):
+            seg_lo = max(bounds[s], self.lo)
+            seg_hi = min(bounds[s + 1], self.hi)
+            if seg_hi <= seg_lo:
+                continue
+            for lo in range(seg_lo, seg_hi, block_variants):
+                hi = min(lo + block_variants, seg_hi)
+                local_lo = lo - self.lo
+                if local_lo < start_variant:
+                    idx += 1
+                    continue
+                meta = self.store._meta(idx, lo, hi, runs[s][0])
+                yield self.store.read_range(lo, hi), _dc_replace(
+                    meta, start=local_lo, stop=hi - self.lo,
+                )
+                idx += 1

@@ -1,0 +1,127 @@
+"""Compaction: stream any GenotypeSource ONCE into the block store.
+
+The ETL tier of the catalog (the reference's "load the cohort into the
+BigQuery table once" job shape): every block the source yields becomes
+one 2-bit-packed chunk file named by the sha256 of its bytes, and the
+manifest — written last, atomically — records the variant/contig/
+position index over them. Because the name IS the content:
+
+- a re-run over identical data rewrites nothing (chunk writes are
+  skipped when the address already exists — dedupe for free);
+- a partially-written chunk can never be mistaken for a good one
+  (files land via tmp + rename, and the reader re-hashes against the
+  address on first touch anyway);
+- a crashed compaction leaves no manifest, so the store simply does
+  not exist yet — re-running is always safe.
+
+Chunks inherit the source's "blocks never span a contig" contract
+(``source.blocks`` flushes at contig boundaries), so every catalog row
+has an exact contig and the store can answer range queries without
+touching data.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from spark_examples_tpu.core import hashing, telemetry
+from spark_examples_tpu.store.manifest import (
+    CHUNK_DIR,
+    POSITIONS_NAME,
+    ChunkRecord,
+    StoreManifest,
+)
+
+
+@telemetry.traced("store.compact", cat="store")
+def compact(path: str, source, chunk_variants: int = 16384) -> StoreManifest:
+    """Stream ``source`` into a content-addressed store at ``path``.
+
+    ``chunk_variants`` is the catalog granularity: the unit of range
+    addressing, integrity verification, and decode caching. It must be
+    divisible by 4 so full chunks stay byte-aligned on the 2-bit grid
+    (which is what lets the reader hand out zero-copy packed slices).
+    Returns the committed manifest.
+    """
+    from spark_examples_tpu.ingest import bitpack
+
+    if chunk_variants <= 0 or chunk_variants % bitpack.VARIANTS_PER_BYTE:
+        raise ValueError(
+            f"chunk_variants must be a positive multiple of "
+            f"{bitpack.VARIANTS_PER_BYTE}, got {chunk_variants}"
+        )
+    n, v = source.n_samples, source.n_variants
+    os.makedirs(os.path.join(path, CHUNK_DIR), exist_ok=True)
+
+    records: list[ChunkRecord] = []
+    positions = np.full(v, -1, np.int64)
+    written = 0  # variants consumed from the stream
+    for block, meta in source.blocks(chunk_variants):
+        if meta.start != written:
+            raise ValueError(
+                f"non-contiguous block stream: expected start {written}, "
+                f"got {meta.start}"
+            )
+        packed = bitpack.pack_dosages(np.ascontiguousarray(block))
+        data = packed.tobytes()
+        digest = hashing.sha256_bytes(data)
+        fname = os.path.join(path, CHUNK_DIR, f"{digest}.bin")
+        # Dedupe by content address — but a wrong-SIZED file under the
+        # right name is a truncated write (or a quarantined chunk), and
+        # re-running the compaction must heal it, not trust the name.
+        # Same-size bit rot is the read path's job (first-touch digest
+        # verify); healing it means deleting the quarantined file and
+        # re-running this compaction.
+        try:
+            fresh = os.path.getsize(fname) != len(data)
+        except OSError:
+            fresh = True
+        if fresh:
+            tmp = fname + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, fname)
+            telemetry.count("store.compact_bytes", float(len(data)))
+        telemetry.count("store.compact_chunks")
+        pos_lo = pos_hi = -1
+        if meta.positions is not None and len(meta.positions):
+            positions[meta.start:meta.stop] = meta.positions
+            pos_lo = int(meta.positions[0])
+            pos_hi = int(meta.positions[-1])
+        records.append(ChunkRecord(
+            start=meta.start, stop=meta.stop, contig=meta.contig,
+            digest=digest, pos_lo=pos_lo, pos_hi=pos_hi,
+        ))
+        written = meta.stop
+    if written != v:
+        raise ValueError(
+            f"source stream ended at {written} of {v} declared variants"
+        )
+    if not records:
+        raise ValueError("source yielded no variants — nothing to compact")
+
+    has_positions = bool((positions >= 0).all())
+    positions_digest = None
+    if has_positions:
+        pos_path = os.path.join(path, POSITIONS_NAME)
+        tmp = pos_path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            tee = hashing.TeeHashWriter(f)
+            np.save(tee, positions)
+        os.replace(tmp, pos_path)
+        positions_digest = tee.sha256.hexdigest()
+
+    manifest = StoreManifest(
+        n_samples=n,
+        n_variants=v,
+        chunk_variants=chunk_variants,
+        sample_hash=hashing.sample_hash(source.sample_ids),
+        chunks=records,
+        sample_ids=list(source.sample_ids),
+        has_positions=has_positions,
+        positions_digest=positions_digest,
+    )
+    manifest.save(path)  # the commit point
+    return manifest

@@ -437,3 +437,61 @@ def test_parquet_schema_errors(tmp_path, rng):
         [b for b, _ in ParquetSource(bare).blocks(8)], axis=1
     )
     np.testing.assert_array_equal(got, g)
+
+
+def test_packed_sidecar_schema_version(tmp_path, genotypes):
+    """save_packed stamps the sidecar; load_packed mirrors load_model's
+    ModelFormatError treatment — pre-versioning, future, truncated, and
+    field-missing sidecars all get a PackedFormatError naming the cause
+    (a long-lived job must be able to diagnose a bad store dir from the
+    exception alone)."""
+    import json
+    import os
+
+    from spark_examples_tpu.ingest.packed import (
+        PACKED_SCHEMA_VERSION,
+        PackedFormatError,
+        save_packed,
+    )
+
+    path = str(tmp_path / "store")
+    save_packed(path, genotypes)
+    meta_path = os.path.join(path, "meta.json")
+    meta = json.load(open(meta_path))
+    assert meta["schema_version"] == PACKED_SCHEMA_VERSION
+    load_packed(path)  # current version loads
+
+    # pre-versioning (retroactively version 1) -> re-pack to upgrade
+    legacy = dict(meta)
+    del legacy["schema_version"]
+    json.dump(legacy, open(meta_path, "w"))
+    with pytest.raises(PackedFormatError, match="pre-versioning"):
+        load_packed(path)
+
+    # a NEWER build's store must not be guessed at
+    future = dict(meta, schema_version=PACKED_SCHEMA_VERSION + 1)
+    json.dump(future, open(meta_path, "w"))
+    with pytest.raises(PackedFormatError, match="newer than this build"):
+        load_packed(path)
+
+    # missing required field, named
+    broken = dict(meta)
+    del broken["n_variants"]
+    json.dump(broken, open(meta_path, "w"))
+    with pytest.raises(PackedFormatError, match="n_variants"):
+        load_packed(path)
+
+    # truncated sidecar
+    open(meta_path, "w").write(json.dumps(meta)[:20])
+    with pytest.raises(PackedFormatError, match="unreadable"):
+        load_packed(path)
+
+    # not a store at all
+    with pytest.raises(PackedFormatError, match="no meta.json"):
+        load_packed(str(tmp_path / "nowhere"))
+
+    # sidecar fine but the genotype payload is gone (interrupted pack)
+    json.dump(meta, open(meta_path, "w"))
+    os.remove(os.path.join(path, "genotypes.2bit.npy"))
+    with pytest.raises(PackedFormatError, match="genotypes.2bit.npy"):
+        load_packed(path)

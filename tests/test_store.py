@@ -1,0 +1,398 @@
+"""Content-addressed dataset store (spark_examples_tpu/store): round-trip
+bit-identity against direct sources, range queries at chunk boundaries,
+deterministic resume, the tiered decode cache's accounting, and the
+integrity story — corrupt-chunk quarantine under the ``store.read``
+fault site, transient-IO recovery through the retry layer."""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.core.config import ReferenceRange
+from spark_examples_tpu.ingest import VcfSource, bitpack, write_vcf
+from spark_examples_tpu.ingest.resilient import RetryingSource, RetryPolicy
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.ingest.synthetic import SyntheticSource
+from spark_examples_tpu.store import (
+    StoreCorruptError,
+    StoreFormatError,
+    compact,
+    open_store,
+)
+from tests.conftest import random_genotypes
+
+
+def _materialize(source, block_variants, start=0):
+    blocks = [b for b, _ in source.blocks(block_variants, start)]
+    return np.concatenate(blocks, axis=1) if blocks else None
+
+
+def _materialize_packed(source, block_variants, start=0):
+    cols = []
+    for pb, m in source.packed_blocks(block_variants, start):
+        cols.append(bitpack.unpack_dosages_np(pb)[:, : m.stop - m.start])
+    return np.concatenate(cols, axis=1)
+
+
+@pytest.fixture
+def store_dir(tmp_path, genotypes):
+    """A compacted store over the shared 37 x 211 cohort, chunk width 32
+    (ragged tail chunk included)."""
+    src = ArraySource(genotypes, contig="chr9",
+                     positions=np.arange(1000, 1000 + 211, dtype=np.int64))
+    d = str(tmp_path / "store")
+    compact(d, src, chunk_variants=32)
+    return d
+
+
+def _multi_contig_vcf(tmp_path, rng):
+    """One VCF holding chr1 (23 variants) + chr2 (10), the contig-
+    boundary shape every grid in the store must respect."""
+    g1 = random_genotypes(rng, 7, 23, 0.1)
+    g2 = random_genotypes(rng, 7, 10, 0.1)
+    p1, p2 = str(tmp_path / "a.vcf"), str(tmp_path / "b.vcf")
+    write_vcf(p1, g1, contig="chr1", start_pos=100)
+    write_vcf(p2, g2, contig="chr2", start_pos=500)
+    header = [l for l in open(p1) if l.startswith("#")]
+    records = [l for p in (p1, p2) for l in open(p) if not l.startswith("#")]
+    multi = str(tmp_path / "multi.vcf")
+    open(multi, "w").writelines(header + records)
+    return multi, g1, g2
+
+
+# ---------------------------------------------------------------------------
+# Round-trip bit-identity
+
+
+def test_roundtrip_bit_identity_synthetic(tmp_path):
+    src = SyntheticSource(n_samples=13, n_variants=501, seed=11)
+    d = str(tmp_path / "s")
+    manifest = compact(d, src, chunk_variants=64)
+    assert manifest.n_variants == 501 and len(manifest.chunks) == 8
+    st = open_store(d)
+    want = _materialize(src, 64)
+    # widths below/at/above/misaligned-with the chunk grid
+    for bv in (32, 64, 100, 256, 501, 1024):
+        np.testing.assert_array_equal(_materialize(st, bv), want)
+    for bv in (32, 64, 256, 1024):  # packed transport needs bv % 4 == 0
+        np.testing.assert_array_equal(_materialize_packed(st, bv), want)
+
+
+def test_roundtrip_vcf_multi_contig(tmp_path, rng):
+    multi, g1, g2 = _multi_contig_vcf(tmp_path, rng)
+    vs = VcfSource(multi)
+    d = str(tmp_path / "s")
+    compact(d, vs, chunk_variants=8)
+    st = open_store(d)
+    want = np.concatenate([g1, g2], axis=1)
+    np.testing.assert_array_equal(_materialize(st, 16), want)
+    # contig labels exact, blocks never span the chr1/chr2 boundary
+    metas = [m for _b, m in st.blocks(16)]
+    assert [m.contig for m in metas] == ["chr1", "chr1", "chr2"]
+    assert [(m.start, m.stop) for m in metas] == [(0, 16), (16, 23), (23, 33)]
+    # positions preserved through the catalog
+    pos = np.concatenate([m.positions for m in metas])
+    np.testing.assert_array_equal(
+        pos, np.r_[np.arange(100, 123), np.arange(500, 510)])
+    # packed transport flushes at the same boundaries
+    np.testing.assert_array_equal(_materialize_packed(st, 16), want)
+    assert not st.exact_n_variants  # multi-contig declines the claim
+    assert open_store(d).manifest.contig_span("chr2") == (23, 33)
+
+
+def test_compaction_dedupes_identical_chunks(tmp_path):
+    g = np.zeros((5, 96), np.int8)  # 3 identical 32-wide chunks
+    d = str(tmp_path / "s")
+    manifest = compact(d, ArraySource(g), chunk_variants=32)
+    assert len(manifest.chunks) == 3
+    assert len({c.digest for c in manifest.chunks}) == 1
+    files = os.listdir(os.path.join(d, "chunks"))
+    assert len(files) == 1  # content addressing = dedupe for free
+    np.testing.assert_array_equal(_materialize(open_store(d), 40), g)
+
+
+def test_recompaction_heals_wrong_sized_chunk(tmp_path, genotypes):
+    src = ArraySource(genotypes)
+    d = str(tmp_path / "s")
+    manifest = compact(d, src, chunk_variants=64)
+    victim = os.path.join(d, manifest.chunks[1].filename())
+    with open(victim, "r+b") as f:
+        f.truncate(5)
+    compact(d, src, chunk_variants=64)  # dedupe must not trust the name
+    np.testing.assert_array_equal(_materialize(open_store(d), 64), genotypes)
+
+
+# ---------------------------------------------------------------------------
+# Range queries + resume
+
+
+def test_range_queries_at_chunk_boundaries(store_dir, genotypes):
+    st = open_store(store_dir)
+    # spans that start/end exactly ON, just inside, and across the
+    # 32-wide chunk grid (and the ragged 211 tail)
+    for lo, hi in ((0, 32), (31, 33), (32, 64), (15, 97), (96, 211),
+                   (210, 211), (207, 211), (0, 211), (64, 64)):
+        np.testing.assert_array_equal(
+            st.read_range(lo, hi), genotypes[:, lo:hi])
+        rs = st.variant_range(lo, hi)
+        assert rs.n_variants == hi - lo
+        if hi > lo:
+            got = _materialize(rs, 13)  # width misaligned with everything
+            np.testing.assert_array_equal(got, genotypes[:, lo:hi])
+    with pytest.raises(ValueError, match="out of bounds"):
+        st.read_range(0, 212)
+
+
+def test_position_span_and_restrict(store_dir, genotypes):
+    st = open_store(store_dir)
+    # positions are 1000..1210; [1032, 1064) covers variants [32, 64)
+    assert st.position_span("chr9", 1032, 1064) == (32, 64)
+    assert st.position_span("chr9", 0, 999) == (1000 - 1000, 0)
+    assert st.position_span("chrX", 0, 10**9) == (0, 0)
+    sub = st.restrict([ReferenceRange("chr9", 1031, 1065)])
+    np.testing.assert_array_equal(_materialize(sub, 16),
+                                  genotypes[:, 31:65])
+    # two ranges chain in order, like partitioned file ingest
+    both = st.restrict([ReferenceRange("chr9", 1000, 1008),
+                        ReferenceRange("chr9", 1100, 1104)])
+    np.testing.assert_array_equal(
+        _materialize(both, 6),
+        np.concatenate([genotypes[:, 0:8], genotypes[:, 100:104]], axis=1))
+    # a miss everywhere still answers cohort metadata with zero variants
+    empty = st.restrict([ReferenceRange("chrX", 0, 10)])
+    assert empty.n_variants == 0 and empty.n_samples == st.n_samples
+
+
+def test_resume_cursors(store_dir, genotypes):
+    st = open_store(store_dir)
+    full = list(st.blocks(48))
+    cursor = full[2][1].stop
+    resumed = list(st.blocks(48, start_variant=cursor))
+    assert [m.start for _b, m in resumed] == [m.start for _b, m in full[3:]]
+    for (a, _), (b, _) in zip(resumed, full[3:]):
+        np.testing.assert_array_equal(a, b)
+    # packed transport resumes on the same grid
+    pk = list(st.packed_blocks(48, start_variant=cursor))
+    assert [m.start for _b, m in pk] == [m.start for _b, m in full[3:]]
+    # a range source resumes on LOCAL cursors
+    rs = st.variant_range(31, 180)
+    rfull = list(rs.blocks(40))
+    rres = list(rs.blocks(40, start_variant=rfull[1][1].stop))
+    np.testing.assert_array_equal(rres[0][0], rfull[2][0])
+
+
+def test_store_through_runner_bit_identical(tmp_path, genotypes):
+    """The drop-in contract: a pcoa job from --source store:<dir> is
+    bit-identical to the same job streaming the source directly."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+
+    src = SyntheticSource(n_samples=16, n_variants=384, seed=2)
+    d = str(tmp_path / "s")
+    compact(d, src, chunk_variants=64)
+    compute = ComputeConfig(metric="ibs", num_pc=3)
+    direct = pcoa_job(JobConfig(
+        ingest=IngestConfig(source="synthetic", n_samples=16,
+                            n_variants=384, seed=2, block_variants=128),
+        compute=compute,
+    ))
+    # the "store:<dir>" spelling normalizes into source/path
+    via_store = pcoa_job(JobConfig(
+        ingest=IngestConfig(source=f"store:{d}", block_variants=128),
+        compute=compute,
+    ))
+    np.testing.assert_array_equal(direct.coords, via_store.coords)
+
+
+def test_cli_ingest_then_store_source(tmp_path, capsys):
+    """CLI surface: `ingest` compacts, `pcoa --source store:<dir>`
+    consumes, coordinates match the straight-from-VCF run."""
+    from spark_examples_tpu.cli.main import main
+
+    rng = np.random.default_rng(8)
+    g = rng.integers(0, 3, (12, 200)).astype(np.int8)
+    vcf = str(tmp_path / "c.vcf")
+    write_vcf(vcf, g, contig="chr3", start_pos=700)
+    store = str(tmp_path / "store")
+    assert main(["ingest", "--source", "vcf", "--path", vcf,
+                 "--chunk-variants", "64", "--output-path", store]) == 0
+    assert "content-addressed chunks" in capsys.readouterr().out
+    a, b = str(tmp_path / "a.tsv"), str(tmp_path / "b.tsv")
+    assert main(["pcoa", "--source", f"store:{store}", "--num-pc", "3",
+                 "--block-variants", "64", "--output-path", a]) == 0
+    assert main(["pcoa", "--source", "vcf", "--path", vcf, "--num-pc",
+                 "3", "--block-variants", "64", "--output-path", b]) == 0
+    capsys.readouterr()
+    ca = np.loadtxt(a, skiprows=1, usecols=(1, 2, 3))
+    cb = np.loadtxt(b, skiprows=1, usecols=(1, 2, 3))
+    np.testing.assert_array_equal(ca, cb)
+
+
+# ---------------------------------------------------------------------------
+# Tiered decode cache
+
+
+def test_decode_cache_accounting(store_dir, genotypes):
+    st = open_store(store_dir)  # 7 chunks of <= 32 variants
+    _materialize(st, 32)  # one decode per chunk
+    s1 = st.cache.stats()
+    assert s1["misses"] == 7 and s1["entries"] == 7
+    _materialize(st, 32)  # second pass: all hits
+    s2 = st.cache.stats()
+    assert s2["misses"] == 7 and s2["hits"] >= 7
+    assert s2["bytes"] == genotypes.nbytes  # dense decodes resident
+
+
+def test_decode_cache_bounded_eviction(store_dir, genotypes):
+    # room for ~2 decoded chunks (37 x 32 = 1184 B each)
+    st = open_store(store_dir, cache_bytes=2500)
+    np.testing.assert_array_equal(_materialize(st, 32), genotypes)
+    np.testing.assert_array_equal(_materialize(st, 32), genotypes)
+    s = st.cache.stats()
+    assert s["evictions"] > 0 and s["bytes"] <= 2500
+    # capacity 0 disables storage, reads stay correct
+    st0 = open_store(store_dir, cache_bytes=0)
+    np.testing.assert_array_equal(_materialize(st0, 32), genotypes)
+    assert st0.cache.stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Integrity: quarantine + fault-harness recovery
+
+
+def test_truncated_chunk_quarantined(store_dir):
+    before = telemetry.counter_value("store.quarantined")
+    with faults.armed(["store.read:truncate:after=2:keep=4"]):
+        st = open_store(store_dir)
+        with pytest.raises(StoreCorruptError) as e:
+            _materialize(st, 32)
+    assert e.value.cursor == 64  # third chunk's first variant
+    assert "start_variant=64" in str(e.value)
+    q = json.load(open(os.path.join(store_dir, "quarantine.json")))
+    assert len(q) == 1 and q[0]["start"] == 64
+    assert telemetry.counter_value("store.quarantined") == before + 1
+
+
+def test_bitflip_fails_digest_verification(store_dir):
+    st = open_store(store_dir)
+    victim = os.path.join(store_dir, st.manifest.chunks[0].filename())
+    raw = bytearray(open(victim, "rb").read())
+    raw[7] ^= 0x40  # same size, different content
+    open(victim, "wb").write(bytes(raw))
+    with pytest.raises(StoreCorruptError, match="content address"):
+        open_store(store_dir).read_range(0, 8)
+    # verify=False skips hashing (the documented fast-and-loose knob)
+    open_store(store_dir, verify=False).read_range(0, 8)
+
+
+def test_missing_chunk_file_quarantined_not_retried(store_dir):
+    """A cataloged chunk that does not exist is damage, not weather —
+    it must quarantine with recovery guidance, not burn the retry
+    layer's reopen budget re-missing the same file."""
+    st = open_store(store_dir)
+    os.remove(os.path.join(store_dir, st.manifest.chunks[3].filename()))
+    before = telemetry.counter_value("ingest.retries")
+    rs = RetryingSource(
+        open_store(store_dir),
+        policy=RetryPolicy(max_retries=3, backoff_s=0.001),
+        reopen=lambda: open_store(store_dir),
+    )
+    with pytest.raises(StoreCorruptError, match="chunk file missing"):
+        _materialize(rs, 32)
+    assert telemetry.counter_value("ingest.retries") == before
+
+
+def test_bad_source_specs_are_usage_errors(capsys):
+    """`vcf:path` and `store:` must die as argparse usage errors, not
+    mid-job tracebacks (other sources take --path)."""
+    from spark_examples_tpu.cli.main import main
+
+    for bad in ("vcf:cohort.vcf", "store:", "nonsense"):
+        with pytest.raises(SystemExit) as e:
+            main(["pcoa", "--source", bad])
+        assert e.value.code == 2
+        capsys.readouterr()
+
+
+def test_corrupt_chunk_not_retried(store_dir):
+    """Corruption is damage, not weather: the retry boundary must fail
+    fast with the cursor named, not burn its budget re-reading it."""
+    before = telemetry.counter_value("ingest.retries")
+    with faults.armed(["store.read:truncate:after=1:keep=4"]):
+        rs = RetryingSource(
+            open_store(store_dir),
+            policy=RetryPolicy(max_retries=3, backoff_s=0.001),
+            reopen=lambda: open_store(store_dir),
+        )
+        with pytest.raises(StoreCorruptError) as e:
+            _materialize(rs, 32)
+    assert e.value.cursor == 32
+    assert telemetry.counter_value("ingest.retries") == before
+
+
+def test_transient_io_error_recovered_bit_identically(store_dir, genotypes):
+    """An injected store.read IOError rides the RetryingSource reopen
+    path (fresh mappings) and the recovered stream is bit-identical."""
+    before = telemetry.counter_value("ingest.retries")
+    with faults.armed(["store.read:io_error:after=3:max=2"]) as inj:
+        rs = RetryingSource(
+            open_store(store_dir),
+            policy=RetryPolicy(max_retries=2, backoff_s=0.001),
+            reopen=lambda: open_store(store_dir),
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            got = _materialize(rs, 32)
+        assert inj.fire_count("store.read") == 2
+    np.testing.assert_array_equal(got, genotypes)
+    assert telemetry.counter_value("ingest.retries") == before + 2
+
+
+# ---------------------------------------------------------------------------
+# Manifest format errors (the load_model()-grade treatment)
+
+
+def _manifest_path(d):
+    return os.path.join(d, "manifest.json")
+
+
+def test_missing_manifest_is_friendly(tmp_path):
+    with pytest.raises(StoreFormatError, match="not a dataset store"):
+        open_store(str(tmp_path / "nope"))
+
+
+def test_pre_versioning_manifest_rejected(store_dir):
+    m = json.load(open(_manifest_path(store_dir)))
+    del m["schema_version"]
+    json.dump(m, open(_manifest_path(store_dir), "w"))
+    with pytest.raises(StoreFormatError, match="pre-versioning"):
+        open_store(store_dir)
+
+
+def test_future_schema_rejected(store_dir):
+    m = json.load(open(_manifest_path(store_dir)))
+    m["schema_version"] = 99
+    json.dump(m, open(_manifest_path(store_dir), "w"))
+    with pytest.raises(StoreFormatError, match="newer than this build"):
+        open_store(store_dir)
+
+
+def test_missing_field_named(store_dir):
+    m = json.load(open(_manifest_path(store_dir)))
+    del m["chunks"]
+    json.dump(m, open(_manifest_path(store_dir), "w"))
+    with pytest.raises(StoreFormatError, match="chunks"):
+        open_store(store_dir)
+
+
+def test_truncated_manifest_rejected(store_dir):
+    raw = open(_manifest_path(store_dir)).read()
+    open(_manifest_path(store_dir), "w").write(raw[: len(raw) // 2])
+    with pytest.raises(StoreFormatError, match="unreadable"):
+        open_store(store_dir)

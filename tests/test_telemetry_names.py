@@ -2,12 +2,16 @@
 used at a telemetry call site in the codebase must be declared in the
 canonical registry (core/telemetry.py NAMES) — a typo'd metric name
 would otherwise silently fork a timeline into two series nobody ever
-joins back together."""
+joins back together. The same contract covers fault sites: every
+literal site string passed to ``faults.fire`` must be declared in
+``faults.SITES`` — an undeclared site would be unarm-able from the env
+grammar (FaultSpec rejects unknown sites), i.e. a recovery path the
+chaos harness can never reach."""
 
 import pathlib
 import re
 
-from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core import faults, telemetry
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
@@ -52,6 +56,42 @@ def test_every_used_name_is_declared():
     )
 
 
+_FIRE = re.compile(r"\bfaults\.fire\(\s*([fr]?)([\"'])([^\"']+)\2")
+
+
+def test_every_fault_site_is_declared():
+    """Every literal site fired in production code is in faults.SITES
+    (and dynamic names are banned outright: a site must be a greppable
+    constant for the harness's docs and specs to reference it)."""
+    undeclared = []
+    fstring_sites = []
+    fired = set()
+    for path in _source_files():
+        text = path.read_text()
+        for m in _FIRE.finditer(text):
+            prefix, _, site = m.groups()
+            line = text[: m.start()].count("\n") + 1
+            if "f" in prefix:
+                fstring_sites.append(f"{path.name}:{line}: f-string site")
+                continue
+            fired.add(site)
+            if site not in faults.SITES:
+                undeclared.append(f"{path.name}:{line}: {site!r}")
+    assert not undeclared, (
+        "fault sites fired but not declared in faults.SITES (declare "
+        "them so specs can arm them): " + "; ".join(undeclared)
+    )
+    assert not fstring_sites, (
+        "faults.fire sites must be literal strings: "
+        + "; ".join(fstring_sites)
+    )
+    # The inverse direction: a declared site nothing fires is a dead
+    # registry entry — the docs would promise an injection point the
+    # harness can't hit.
+    dead = set(faults.SITES) - fired
+    assert not dead, f"declared fault sites never fired in code: {dead}"
+
+
 def test_registry_is_well_formed():
     assert telemetry.NAMES, "registry emptied"
     for name, entry in telemetry.NAMES.items():
@@ -94,6 +134,15 @@ def test_core_names_present():
         "serve.cache_misses",
         "serve.deadline_expired",
         "serve.in_flight",
+        # dataset-store subsystem (registered from day one)
+        "store.compact",
+        "store.chunk_read",
+        "store.compact_bytes",
+        "store.cache_hits",
+        "store.cache_misses",
+        "store.verify_failures",
+        "store.quarantined",
+        "store.cache_bytes",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution
