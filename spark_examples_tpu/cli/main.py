@@ -88,7 +88,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "read concurrently (the reference partitioner's "
                    "FixedContigSplits); 1 disables")
     g.add_argument("--ingest-workers", type=int, default=4,
-                   help="concurrent range readers for --splits-per-contig")
+                   help="host-side ingest parallelism: concurrent range "
+                   "readers for --splits-per-contig AND parse/pack/"
+                   "hash/write workers for `ingest` compaction "
+                   "(ordered reassembly keeps the output bit-identical "
+                   "to 1 worker; see README 'Performance tuning')")
     g.add_argument("--maf", type=float, default=0.0,
                    help="drop variants with minor-allele frequency below "
                    "this (QC stream filter)")
@@ -121,6 +125,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "cache (dense chunk decodes, LRU with hit/miss "
                    "accounting; 0 disables — see README 'Dataset "
                    "store')")
+    g.add_argument("--readahead-chunks", type=int, default=2,
+                   help="dataset-store readahead depth: chunks decoded "
+                   "+ digest-verified AHEAD of the streaming cursor by "
+                   "a background pool into the decode cache, so the "
+                   "store-cold tier runs at store-hit throughput "
+                   "(0 disables; see README 'Performance tuning')")
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
@@ -218,6 +228,7 @@ def _job_from_args(args) -> JobConfig:
             io_retries=args.io_retries,
             io_retry_backoff_s=args.io_retry_backoff,
             store_cache_mb=args.store_cache_mb,
+            readahead_chunks=args.readahead_chunks,
         ),
         compute=ComputeConfig(
             backend=args.backend,
@@ -682,7 +693,8 @@ def _dispatch(args, parser, job, J, build_source) -> int:
         src = build_source(job.ingest)
         t0 = _time.perf_counter()
         manifest = compact(job.output_path, src,
-                           chunk_variants=args.chunk_variants)
+                           chunk_variants=args.chunk_variants,
+                           workers=job.ingest.ingest_workers)
         dt = _time.perf_counter() - t0
         dense_mb = manifest.n_samples * manifest.n_variants / 1e6
         print(
@@ -690,7 +702,8 @@ def _dispatch(args, parser, job, J, build_source) -> int:
             f"{manifest.n_variants} variants into {len(manifest.chunks)} "
             f"content-addressed chunks ({dense_mb / 4:.1f} MB 2-bit) -> "
             f"{job.output_path} in {dt:.1f}s "
-            f"({dense_mb / max(dt, 1e-9):.0f} MB/s dense-equivalent); "
+            f"({dense_mb / max(dt, 1e-9):.0f} MB/s dense-equivalent, "
+            f"{job.ingest.ingest_workers} workers); "
             f"read it back with --source store:{job.output_path}"
         )
         return 0

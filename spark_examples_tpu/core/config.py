@@ -73,6 +73,10 @@ class IngestConfig:
     # each --references range into this many sub-ranges and read them
     # with `ingest_workers` concurrent reader threads (order-preserving
     # — the emitted stream is identical to the sequential one). 1 = off.
+    # `ingest_workers` also sizes the parallel ingest engine
+    # (ingest/parallel.py): `ingest` compaction shards parse + 2-bit
+    # pack + hash + chunk writes over this many workers with ordered
+    # reassembly (bit-identical output; 1 = serial).
     splits_per_contig: int = 1
     ingest_workers: int = 4
     # Host->device pipeline depth: how many produced blocks may wait in
@@ -107,8 +111,40 @@ class IngestConfig:
     # budget of the bounded decode cache (dense chunk decodes; tier 2
     # of mmap -> cache -> consumer). 0 disables caching.
     store_cache_mb: int = 256
+    # Store readahead (store/readahead.py): chunks decoded + verified
+    # AHEAD of the streaming cursor by a background pool into the
+    # decode cache, turning the store-cold tier into store-hit
+    # throughput. 0 disables.
+    readahead_chunks: int = 2
 
     def __post_init__(self):
+        # Knob validation AT CONFIG TIME — the ingest pipeline runs its
+        # knobs inside producer/worker threads, where a nonsense value
+        # surfaces as a hang (a 0-deep queue), a deep traceback in a
+        # pool worker, or a silent clamp. Reject here, with the flag
+        # named, before any thread exists.
+        def _check(name, value, lo, hi, why):
+            if not lo <= value <= hi:
+                raise ValueError(
+                    f"bad ingest config: {name}={value!r} — expected an "
+                    f"integer in [{lo}, {hi}] ({why})"
+                )
+
+        _check("block_variants", self.block_variants, 1, 1 << 26,
+               "variants per streamed block")
+        _check("prefetch_blocks", self.prefetch_blocks, 1, 4096,
+               "host->device pipeline depth; the stream cannot run "
+               "unbuffered, so at least 1")
+        _check("ingest_workers", self.ingest_workers, 1, 256,
+               "parse/pack worker threads; 1 = serial")
+        _check("splits_per_contig", self.splits_per_contig, 1, 65536,
+               "sub-ranges per --references contig; 1 = off")
+        _check("readahead_chunks", self.readahead_chunks, 0, 65536,
+               "store chunks decoded ahead of the cursor; 0 = off")
+        _check("store_cache_mb", self.store_cache_mb, 0, 1 << 20,
+               "decode-cache budget in MB; 0 = no cache")
+        _check("io_retries", self.io_retries, 0, 1000,
+               "transient-IO retries per incident; 0 = no retry")
         # `--source store:<dir>` — the one-flag spelling of the
         # content-addressed store, accepted everywhere a source is.
         if self.source.startswith("store:"):

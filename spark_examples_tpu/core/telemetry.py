@@ -142,6 +142,28 @@ NAMES: dict[str, tuple[str, str]] = {
     "ingest.corrupt_blocks": ("counter", "corrupt blocks failed fast (never retried)"),
     "ingest.exhausted": ("counter", "retry budgets exhausted (job-killing incidents)"),
     "ingest.backoff_s": ("counter", "seconds slept in retry backoff"),
+    "ingest.parallel_shards": (
+        "counter",
+        "shards dispatched to the parallel ingest engine's worker pool "
+        "(VCF byte ranges / exact-source block stripes; 0 in a run "
+        "means every stream took the serial path)",
+    ),
+    "store.readahead.scheduled": (
+        "counter",
+        "chunk warms submitted to the store readahead pool (decode + "
+        "first-touch verify ahead of the streaming cursor)",
+    ),
+    "store.readahead.hits": (
+        "counter",
+        "consumer chunk reads served by a completed (or awaited) "
+        "background warm instead of an inline cold decode",
+    ),
+    "store.readahead.errors": (
+        "counter",
+        "warms that failed in a pool worker — each error is re-raised "
+        "in the consumer when its cursor reaches the chunk, through "
+        "the ordinary retry/fail-fast boundary, never swallowed",
+    ),
     "checkpoint.bytes_written": ("counter", "checkpoint data bytes written by this rank"),
     "faults.fired": ("counter", "fault-injection specs fired (all sites)"),
     "hard_sync.fallback": (
@@ -235,6 +257,17 @@ NAMES: dict[str, tuple[str, str]] = {
         "cache (bounded by --store-cache-mb; max == the bound means "
         "the working set does not fit and evictions are live)",
     ),
+    "store.readahead.in_flight": (
+        "gauge",
+        "chunk warms pending in the readahead pool; pinned at 0 means "
+        "the consumer outruns the warms (raise --readahead-chunks), "
+        "pinned at depth means readahead is fully ahead (healthy)",
+    ),
+    "prefetch.transfers_in_flight": (
+        "gauge",
+        "host->device transfers dispatched ahead of the yielded block "
+        "in the K-deep feed (bounded by the transfer ring depth)",
+    ),
     # -- histograms -------------------------------------------------------
     "prefetch.put_wait_s": (
         "histogram",
@@ -245,6 +278,31 @@ NAMES: dict[str, tuple[str, str]] = {
         "histogram",
         "consumer wait per block for the producer (large => ingest is the "
         "bottleneck; sum/gram time = the stall fraction)",
+    ),
+    "prefetch.stage_wait_s": (
+        "histogram",
+        "producer wait per block for a free host staging slab (large => "
+        "the transfer/compute side of the ring is the bottleneck and "
+        "every slab is in flight)",
+    ),
+    "prefetch.transfer_wait_s": (
+        "histogram",
+        "residual wait at block retire time for its host->device "
+        "transfer to complete before the staging slab rotates back — "
+        "~0 when the K-deep pipeline hides the transfer entirely",
+    ),
+    "ingest.reassembly_wait_s": (
+        "histogram",
+        "per in-order result: consumer wait at the parallel ingest "
+        "engine's ordered reassembly buffer (large => one straggler "
+        "shard gates the stream; ~0 => workers run ahead of the "
+        "consumer)",
+    ),
+    "store.readahead.wait_s": (
+        "histogram",
+        "consumer wait for an in-flight background warm of the chunk "
+        "its cursor just reached (the readahead analogue of "
+        "prefetch.get_wait_s; large => raise --readahead-chunks)",
     ),
     "serve.enqueue_wait_s": (
         "histogram",

@@ -1,6 +1,7 @@
 from spark_examples_tpu.ingest import (  # noqa: F401
     bitpack,
     packed,
+    parallel,
     parquet,
     plink,
     prefetch,

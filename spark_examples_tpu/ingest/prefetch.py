@@ -1,14 +1,34 @@
-"""Double-buffered host→device feeding.
+"""K-deep pipelined host→device feeding on a donated staging ring.
 
 The reference overlapped nothing: executors paged the Genomics API inside
 ``compute`` and Spark hid latency only via many concurrent tasks
-(SURVEY.md §3.5). On TPU the equivalent overlap is explicit: a background
-thread produces host blocks while the chip crunches the previous one, and
-``jax.device_put`` of block k+1 overlaps the accumulation FMA of block k
-(dispatch is async). Ragged final blocks are padded to the full block
-width with MISSING (-1), which is semantically free — a missing call
-contributes zero to every gram piece — and keeps a single compiled shape
-for the whole stream (SURVEY.md §7 step 2 "double-buffered feed").
+(SURVEY.md §3.5). On TPU the equivalent overlap is explicit and now runs
+three stages deep: a background producer thread parses/packs host blocks
+into a **rotating ring of reusable host staging buffers** (the pinned-
+slab analogue — each slab is written once per rotation and handed to
+``jax.device_put``, then recycled only after its transfer completed, so
+the allocator never churns a fresh 10–40 MB block per step); slab
+recycling lags :data:`TRANSFER_DEPTH` blocks behind the yield point, so
+transfers always have a full pipeline period to drain before their slab
+rotates. Net: block k accumulates on the chip while k+1's transfer
+drains and k+2 stages into a recycled slab — at exactly the block
+cadence (cursors, checkpoints, error positions) a depth-1 feed had
+(SURVEY.md §7 step 2, deepened).
+
+Ragged final blocks are padded to the full block width with MISSING
+(-1), which is semantically free — a missing call contributes zero to
+every gram piece — and keeps a single compiled shape for the whole
+stream. Zero-copy packed sources (the 2-bit stores) bypass staging
+entirely: their blocks are read-only views of an mmap, already stable
+host memory with nothing to recycle.
+
+Per-stage telemetry: ``prefetch.stage_wait_s`` (producer waits for a
+free slab — the transfer/compute side is the bottleneck),
+``prefetch.put_wait_s`` / ``prefetch.get_wait_s`` (queue backpressure /
+consumer starvation, as before), ``prefetch.transfer_wait_s`` (residual
+wait for a transfer at retire time — ~0 when the pipeline is deep
+enough) and the ``prefetch.queue_depth`` / ``prefetch.transfers_in_
+flight`` gauges.
 """
 
 from __future__ import annotations
@@ -16,6 +36,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import Iterator
 
 import jax
@@ -31,6 +52,99 @@ _END = object()
 # A byte of four missing codes (0b11_11_11_11) — the packed twin of
 # MISSING, shared with the multi-host feeder's padding slabs.
 PACKED_MISSING = 0xFF
+
+# How many blocks a staged slab's recycling lags behind its yield: the
+# slab of block k rotates back when block k+TRANSFER_DEPTH is yielded,
+# by which time k's transfer has had a full pipeline period to complete
+# (the residual wait is prefetch.transfer_wait_s). 2 keeps at most 3
+# slabs transfer-bound beyond the queue.
+TRANSFER_DEPTH = 2
+
+
+def _can_stage(device, sharding) -> bool:
+    """Whether the reusable staging ring is SAFE for this placement.
+
+    On accelerator targets ``jax.device_put`` of a NumPy array is a real
+    host->device copy (immutable only until the transfer completes —
+    which the retire-time ready wait guarantees before a slab rotates).
+    On the CPU backend it is **zero-copy**: the returned array aliases
+    the host buffer for its whole life, so recycling the slab would
+    rewrite blocks the consumer still holds. There is also no transfer
+    to overlap there — staging buys nothing — so CPU placements run
+    unstaged (fresh buffer per block, the pre-ring behavior).
+    """
+    try:
+        if sharding is not None:
+            return all(d.platform != "cpu" for d in sharding.device_set)
+        if device is not None:
+            return device.platform != "cpu"
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+class _Slot:
+    """One staging slab plus its way home."""
+
+    __slots__ = ("buf", "_ring")
+
+    def __init__(self, buf, ring):
+        self.buf = buf
+        self._ring = ring
+
+    def release(self):
+        self._ring.release(self)
+
+
+class _StagingRing:
+    """Rotating pool of reusable host staging buffers.
+
+    Slabs are allocated lazily up to ``n_slots`` (a short stream never
+    pays for the full ring) and recycled through a queue: the producer
+    blocks in :meth:`acquire` when every slab is in flight — which is
+    exactly the backpressure the bounded block queue used to provide,
+    now extended over the transfer stage too.
+    """
+
+    def __init__(self, n_slots: int, shape, dtype, fill):
+        self._shape, self._dtype, self._fill = shape, dtype, fill
+        self._free: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._allocated = 0
+        self._n_slots = max(1, int(n_slots))
+
+    def acquire(self, stop: threading.Event) -> "_Slot | None":
+        t0 = time.perf_counter()
+        while not stop.is_set():
+            try:
+                slot = self._free.get_nowait()
+            except queue.Empty:
+                slot = None
+                with self._lock:
+                    if self._allocated < self._n_slots:
+                        self._allocated += 1
+                        slot = _Slot(
+                            np.full(self._shape, self._fill, self._dtype),
+                            self,
+                        )
+                if slot is None:
+                    try:
+                        slot = self._free.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+            # Re-check AFTER winning a slot: on abandonment the consumer
+            # stops the producer and then releases in-flight slabs — a
+            # get racing that release could otherwise hand the producer
+            # a slab whose transfer is still live.
+            if stop.is_set():
+                return None
+            telemetry.observe("prefetch.stage_wait_s",
+                              time.perf_counter() - t0)
+            return slot
+        return None
+
+    def release(self, slot: "_Slot") -> None:
+        self._free.put(slot)
 
 
 def pad_block(block: np.ndarray, block_variants: int) -> np.ndarray:
@@ -77,13 +191,16 @@ def stream_host_blocks(
 
     The host half of :func:`stream_to_device` — same producer thread,
     bounded queue, padding, packing, and stats contract, but the blocks
-    stay host-resident. The multi-host feeder consumes this directly
-    (each process assembles its slab into a global array itself).
+    stay host-resident (and unstaged: the consumer owns each block
+    indefinitely, so the reusable ring cannot apply). The multi-host
+    feeder consumes this directly (each process assembles its slab into
+    a global array itself).
     """
-    yield from _produce_host_blocks(
+    for host, _slot, meta in _produce_host_blocks(
         source, block_variants, start_variant, prefetch, pad_multiple,
-        pack, stats,
-    )
+        pack, stats, staging=False,
+    ):
+        yield host, meta
 
 
 def stream_to_device(
@@ -123,32 +240,113 @@ def stream_to_device(
     the runner's int32-accumulator exactness guard for arbitrary int8
     tables; computed off the critical path.
     """
-    for host_block, meta in _produce_host_blocks(
-        source, block_variants, start_variant, prefetch, pad_multiple,
-        pack, stats,
-    ):
-        # Chaos site: a "delay" here is a stalled host->device link (the
-        # prefetch queue must absorb it); an "io_error" is a failed
-        # transfer (not retryable — the stream's cursor semantics make
-        # the job resumable from its checkpoint instead).
-        faults.fire("device.put")
+
+    def place(host):
         if sharding is not None:
-            dev_block = jax.device_put(host_block, sharding)
-        elif device is not None:
-            dev_block = jax.device_put(host_block, device)
-        else:
-            dev_block = jax.device_put(host_block)
-        yield dev_block, meta
+            return jax.device_put(host, sharding)
+        if device is not None:
+            return jax.device_put(host, device)
+        return jax.device_put(host)
+
+    # Slabs whose transfers may still be in flight: a staged slab only
+    # rotates back once ITS device_put completed (mutating host memory
+    # under an in-flight H2D copy is the one bug this ring must never
+    # have). Recycling lags TRANSFER_DEPTH blocks behind the yield
+    # point, so by the time a slab is reclaimed its transfer started
+    # TRANSFER_DEPTH blocks ago — the ready-wait is the residual, ~0 in
+    # a healthy pipeline, and measured when it is not. Yields themselves
+    # are NEVER delayed: the consumer sees exactly the block cadence a
+    # depth-1 feed had (checkpoint cursors, producer skew, and error
+    # positions are unchanged by the ring).
+    pending: deque = deque()
+
+    def recycle_oldest():
+        dev, slot = pending.popleft()
+        t0 = time.perf_counter()
+        dev.block_until_ready()
+        telemetry.observe("prefetch.transfer_wait_s",
+                          time.perf_counter() - t0)
+        slot.release()
+
+    producer = _produce_host_blocks(
+        source, block_variants, start_variant, prefetch, pad_multiple,
+        pack, stats, staging=_can_stage(device, sharding),
+    )
+    try:
+        for host_block, slot, meta in producer:
+            # Chaos site: a "delay" here is a stalled host->device link
+            # (the prefetch queue must absorb it); an "io_error" is a
+            # failed transfer (not retryable — the stream's cursor
+            # semantics make the job resumable from its checkpoint
+            # instead).
+            faults.fire("device.put")
+            dev_block = place(host_block)
+            if slot is not None:
+                pending.append((dev_block, slot))
+                telemetry.gauge_set("prefetch.transfers_in_flight",
+                                    float(len(pending)))
+                if len(pending) > TRANSFER_DEPTH:
+                    recycle_oldest()
+            yield dev_block, meta
+    finally:
+        # Stop the producer FIRST (its generator's finally sets the stop
+        # event), THEN release the in-flight slabs: released in the
+        # other order, a producer blocked on the ring could win a slab
+        # whose transfer is still live and overwrite it under the copy —
+        # the aliasing bug the ring exists to prevent. acquire()'s
+        # post-get stop check closes the remaining race.
+        producer.close()
+        while pending:
+            _dev, slot = pending.popleft()
+            slot.release()
 
 
 def _produce_host_blocks(
     source, block_variants, start_variant, prefetch, pad_multiple, pack,
-    stats,
+    stats, staging=False,
 ):
+    """The producer thread: yields ``(host_array, slot | None, meta)``.
+
+    ``staging`` arms the reusable-slab ring for paths that materialize a
+    fresh host buffer per block (dense padding, host-side 2-bit
+    packing); the zero-copy packed-source path stays unstaged — its
+    blocks are read-only mmap views, already stable host memory.
+    """
     q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
     stop = threading.Event()
     grid = pad_multiple * (bitpack.VARIANTS_PER_BYTE if pack else 1)
     width = -(-block_variants // grid) * grid
+
+    zero_copy = (
+        pack
+        and hasattr(source, "packed_blocks")
+        and block_variants % bitpack.VARIANTS_PER_BYTE == 0
+    )
+    ring = None
+    if staging and not zero_copy:
+        n_slots = max(1, prefetch) + TRANSFER_DEPTH + 2
+        if pack:
+            ring = _StagingRing(
+                n_slots,
+                (source.n_samples, width // bitpack.VARIANTS_PER_BYTE),
+                np.uint8, PACKED_MISSING,
+            )
+        else:
+            ring = _StagingRing(
+                n_slots, (source.n_samples, width), GENOTYPE_DTYPE, MISSING,
+            )
+
+    def _stage(host) -> "tuple | None":
+        """Copy a freshly-built block into a recycled slab; None means
+        the stream was abandoned while waiting for a free slot."""
+        slot = ring.acquire(stop)
+        if slot is None:
+            return None
+        v = host.shape[1]
+        np.copyto(slot.buf[:, :v], host)
+        if v < slot.buf.shape[1]:
+            slot.buf[:, v:] = PACKED_MISSING if pack else MISSING
+        return slot.buf, slot
 
     def _put(item, measure: bool = True) -> bool:
         # Producer-side backpressure metric: time this block waited for
@@ -174,21 +372,24 @@ def _produce_host_blocks(
 
     def produce():
         try:
-            if (
-                pack
-                and hasattr(source, "packed_blocks")
-                and block_variants % bitpack.VARIANTS_PER_BYTE == 0
-            ):
+            if zero_copy:
                 w_bytes = width // bitpack.VARIANTS_PER_BYTE
                 for pblock, meta in source.packed_blocks(
                     block_variants, start_variant
                 ):
-                    if not _put((pad_packed(pblock, w_bytes), meta)):
+                    if not _put((pad_packed(pblock, w_bytes), None, meta)):
                         return
             elif pack:
                 for block, meta in source.blocks(block_variants, start_variant):
                     host = bitpack.pack_dosages(pad_block(block, width))
-                    if not _put((host, meta)):
+                    if ring is not None:
+                        staged = _stage(host)
+                        if staged is None:
+                            return
+                        host, slot = staged
+                    else:
+                        slot = None
+                    if not _put((host, slot, meta)):
                         return
             else:
                 for block, meta in source.blocks(block_variants, start_variant):
@@ -196,7 +397,14 @@ def _produce_host_blocks(
                         stats["max_value"] = max(
                             stats.get("max_value", 0), int(block.max())
                         )
-                    if not _put((pad_block(block, width), meta)):
+                    if ring is not None:
+                        staged = _stage(block)
+                        if staged is None:
+                            return
+                        host, slot = staged
+                    else:
+                        host, slot = pad_block(block, width), None
+                    if not _put((host, slot, meta)):
                         return
             _put(_END, measure=False)
         except BaseException as e:  # propagate into consumer

@@ -57,6 +57,86 @@ def _dosage(gt: str) -> int:
     return min(dose, 2)
 
 
+def parse_record_lines(lines, n_samples: int, in_range, path: str,
+                       ) -> Iterator[tuple[str, int, np.ndarray]]:
+    """Yield (contig, pos, int8 dosage column) from raw VCF record lines.
+
+    THE per-record parse — shared verbatim by the serial stream
+    (``VcfSource._records``) and the byte-range shard workers of the
+    parallel ingest engine (ingest/parallel.py), so an N-worker parse is
+    bit-identical to the serial one by construction, not by parallel
+    maintenance of two parsers. ``lines`` is any iterable of raw byte
+    lines (a file object, a byte-range slice); header/short lines are
+    skipped with the same semantics either way.
+
+    Splits only the 9 fixed VCF columns in Python; the per-sample GT
+    parse — the loop that runs N times per record — goes through the
+    native parser when available (a C call that releases the GIL, which
+    is what lets shard workers parse concurrently), with a GT-string-
+    cached Python fallback carrying identical semantics (pinned by tests
+    under SPARK_TPU_NO_NATIVE=1).
+    """
+    from spark_examples_tpu import native
+
+    n = n_samples
+    use_native = native.load() is not None
+    gt_cache: dict[bytes, int] = {}
+    short_records = 0
+    for line in lines:
+        if line.startswith(b"#"):
+            continue
+        # \r too: binary reads see CRLF files raw (text mode's
+        # universal newlines used to hide this), and a trailing
+        # \r would corrupt the last sample's GT.
+        line = line.rstrip(b"\r\n")
+        prefix = line.split(b"\t", 9)
+        if len(prefix) < 10:
+            continue
+        contig, pos = prefix[0].decode(), int(prefix[1])
+        if not in_range(contig, pos):
+            continue
+        fmt = prefix[8].split(b":")
+        try:
+            gt_idx = fmt.index(b"GT")
+        except ValueError:
+            continue  # no genotypes at this site
+        col = np.empty(n, dtype=np.int8)
+        if use_native and native.vcf_parse_gt(line, gt_idx, n, col):
+            yield contig, pos, col
+            continue
+        gts = prefix[9].split(b"\t")
+        if len(gts) < n:
+            # Truncated/malformed record (interrupted download,
+            # mid-line cut). Skipping silently would present a
+            # clean job computed on reduced data — warn loudly,
+            # once per stream.
+            short_records += 1
+            if short_records == 1:
+                import warnings
+
+                warnings.warn(
+                    f"{path}: record at {contig}:{pos} has "
+                    f"{len(gts)} sample columns, expected {n} — "
+                    "skipping record(s); the file may be "
+                    "truncated or malformed",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+            continue
+        for i in range(n):
+            # VCF permits dropping trailing subfields, so a short
+            # sample column means GT is absent -> missing (the
+            # native parser's 'missing subfield' branch).
+            sub = gts[i].split(b":")
+            gt = sub[gt_idx] if gt_idx < len(sub) else b""
+            d = gt_cache.get(gt)
+            if d is None:
+                d = _dosage(gt.decode())
+                gt_cache[gt] = d
+            col[i] = d
+        yield contig, pos, col
+
+
 @dataclass
 class VcfSource:
     path: str
@@ -125,74 +205,11 @@ class VcfSource:
         return False
 
     def _records(self) -> Iterator[tuple[str, int, np.ndarray]]:
-        """Yield (contig, pos, int8 dosage column).
-
-        Splits only the 9 fixed VCF columns in Python; the per-sample GT
-        parse — the loop that runs N times per record — goes through the
-        native parser when available, with a GT-string-cached Python
-        fallback carrying identical semantics (pinned by tests under
-        SPARK_TPU_NO_NATIVE=1).
-        """
-        from spark_examples_tpu import native
-
-        n = self.n_samples
-        use_native = native.load() is not None
-        gt_cache: dict[bytes, int] = {}
-        short_records = 0
+        """Yield (contig, pos, int8 dosage column) for the whole file."""
         with _open_bytes(self.path) as f:
-            for line in f:
-                if line.startswith(b"#"):
-                    continue
-                # \r too: binary reads see CRLF files raw (text mode's
-                # universal newlines used to hide this), and a trailing
-                # \r would corrupt the last sample's GT.
-                line = line.rstrip(b"\r\n")
-                prefix = line.split(b"\t", 9)
-                if len(prefix) < 10:
-                    continue
-                contig, pos = prefix[0].decode(), int(prefix[1])
-                if not self._in_range(contig, pos):
-                    continue
-                fmt = prefix[8].split(b":")
-                try:
-                    gt_idx = fmt.index(b"GT")
-                except ValueError:
-                    continue  # no genotypes at this site
-                col = np.empty(n, dtype=np.int8)
-                if use_native and native.vcf_parse_gt(line, gt_idx, n, col):
-                    yield contig, pos, col
-                    continue
-                gts = prefix[9].split(b"\t")
-                if len(gts) < n:
-                    # Truncated/malformed record (interrupted download,
-                    # mid-line cut). Skipping silently would present a
-                    # clean job computed on reduced data — warn loudly,
-                    # once per stream.
-                    short_records += 1
-                    if short_records == 1:
-                        import warnings
-
-                        warnings.warn(
-                            f"{self.path}: record at {contig}:{pos} has "
-                            f"{len(gts)} sample columns, expected {n} — "
-                            "skipping record(s); the file may be "
-                            "truncated or malformed",
-                            RuntimeWarning,
-                            stacklevel=3,
-                        )
-                    continue
-                for i in range(n):
-                    # VCF permits dropping trailing subfields, so a short
-                    # sample column means GT is absent -> missing (the
-                    # native parser's 'missing subfield' branch).
-                    sub = gts[i].split(b":")
-                    gt = sub[gt_idx] if gt_idx < len(sub) else b""
-                    d = gt_cache.get(gt)
-                    if d is None:
-                        d = _dosage(gt.decode())
-                        gt_cache[gt] = d
-                    col[i] = d
-                yield contig, pos, col
+            yield from parse_record_lines(
+                f, self.n_samples, self._in_range, self.path
+            )
 
     def blocks(self, block_variants: int, start_variant: int = 0):
         """Stream (N, <=block_variants) blocks.

@@ -129,6 +129,12 @@ def load() -> ctypes.CDLL | None:
         lib.unpack_dosages_u8.restype = None
         lib.vcf_parse_gt.argtypes = [cp, i64, i64, i64, i8p, i64]
         lib.vcf_parse_gt.restype = i64
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.vcf_parse_block.argtypes = [
+            cp, i64, i64, i64, i8p, i64p, i64p, i64p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.vcf_parse_block.restype = i64
         _lib = lib
         return _lib
 
@@ -174,3 +180,46 @@ def vcf_parse_gt(line: bytes, gt_index: int, n_samples: int,
         return False
     got = lib.vcf_parse_gt(line, len(line), 9, gt_index, out, n_samples)
     return got == n_samples
+
+
+def vcf_parse_block(buf: bytes, n_samples: int):
+    """Parse every VCF data line in ``buf`` in one GIL-released call.
+
+    Returns ``(dosages (r, n_samples) int8, positions (r,) int64,
+    contigs list[str], n_short)`` for the ``r`` accepted records, in
+    file order — skip semantics identical to the Python record parser
+    (ingest/vcf.py parse_record_lines). Returns None when the library
+    is unavailable OR the batch hit input the C parser punts on (a
+    non-integer POS field): the caller must fall back to the Python
+    parser, which raises the same error a serial parse would.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    # Output bound: an ACCEPTED record occupies at least n_samples + 9
+    # bytes of buf (its tab separators alone), so sizing by newline
+    # count alone is capped by that — a garbled shard of millions of
+    # short junk lines must not translate into a multi-GB allocation
+    # per worker (the C side punts the batch if the bound ever proves
+    # too small, so the cap can never silently drop records).
+    max_records = min(
+        buf.count(b"\n") + 1,
+        len(buf) // max(1, n_samples + 9) + 1,
+    )
+    out = np.empty((max_records, n_samples), np.int8)
+    pos = np.empty(max_records, np.int64)
+    coff = np.empty(max_records, np.int64)
+    clen = np.empty(max_records, np.int64)
+    n_short = ctypes.c_int64(0)
+    n_reject = ctypes.c_int64(0)
+    r = lib.vcf_parse_block(
+        buf, len(buf), n_samples, max_records, out, pos, coff, clen,
+        ctypes.byref(n_short), ctypes.byref(n_reject),
+    )
+    if n_reject.value:
+        return None
+    contigs = [
+        buf[o:o + w].decode()
+        for o, w in zip(coff[:r].tolist(), clen[:r].tolist())
+    ]
+    return out[:r], pos[:r], contigs, int(n_short.value)

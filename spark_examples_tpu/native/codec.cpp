@@ -81,28 +81,15 @@ void unpack_dosages_u8(const uint8_t* packed, int64_t n, int64_t w,
     }
 }
 
-// One VCF record's sample columns -> int8 dosages.
-//
-// `line` spans the whole tab-separated record (no trailing newline
-// required); parsing starts after `skip_fields` tabs (9 = the fixed VCF
-// columns). Each sample field is split on ':', subfield `gt_index` is
-// the GT string; alleles split on '/' or '|'. Semantics identical to
-// ingest/vcf.py _dosage: any non-"0" called allele adds 1 (capped at
-// 2), "." alleles are skipped, no called allele -> -1 (missing).
-// Returns the number of samples parsed (== n_samples on success), or -1
-// if the record has fewer sample columns than n_samples.
-int64_t vcf_parse_gt(const char* line, int64_t len, int64_t skip_fields,
-                     int64_t gt_index, int8_t* out, int64_t n_samples) {
-    const char* p = line;
-    const char* end = line + len;
-    for (int64_t f = 0; f < skip_fields; ++f) {
-        while (p < end && *p != '\t') ++p;
-        if (p >= end) return -1;
-        ++p;                                  // past the tab
-    }
+// Shared sample-column scan of one record: parse `n_samples` GT
+// subfields starting at `p` (the first sample column). Returns samples
+// parsed; < n_samples means a short record.
+static int64_t parse_samples(const char* p, const char* end,
+                             int64_t gt_index, int8_t* out,
+                             int64_t n_samples) {
     int64_t s = 0;
     while (s < n_samples) {
-        if (p > end) return -1;
+        if (p > end) return s;
         const char* fend = p;
         while (fend < end && *fend != '\t') ++fend;
         // Select colon-subfield gt_index within [p, fend).
@@ -133,6 +120,124 @@ int64_t vcf_parse_gt(const char* line, int64_t len, int64_t skip_fields,
         p = fend + 1;
     }
     return s;
+}
+
+// One VCF record's sample columns -> int8 dosages.
+//
+// `line` spans the whole tab-separated record (no trailing newline
+// required); parsing starts after `skip_fields` tabs (9 = the fixed VCF
+// columns). Each sample field is split on ':', subfield `gt_index` is
+// the GT string; alleles split on '/' or '|'. Semantics identical to
+// ingest/vcf.py _dosage: any non-"0" called allele adds 1 (capped at
+// 2), "." alleles are skipped, no called allele -> -1 (missing).
+// Returns the number of samples parsed (== n_samples on success), or -1
+// if the record has fewer sample columns than n_samples.
+int64_t vcf_parse_gt(const char* line, int64_t len, int64_t skip_fields,
+                     int64_t gt_index, int8_t* out, int64_t n_samples) {
+    const char* p = line;
+    const char* end = line + len;
+    for (int64_t f = 0; f < skip_fields; ++f) {
+        while (p < end && *p != '\t') ++p;
+        if (p >= end) return -1;
+        ++p;                                  // past the tab
+    }
+    return parse_samples(p, end, gt_index, out, n_samples);
+}
+
+// Batch parse: every VCF data line in buf[0, len) in ONE call — the
+// whole-shard inner loop of the parallel ingest engine. A single-line
+// call pays ctypes marshaling + Python line handling per RECORD (which
+// also holds the GIL, so shard worker threads cannot scale); this
+// parses a shard's worth per call with the GIL released throughout.
+//
+// Per accepted record r: out[r, :] = dosages, out_pos[r] = POS, and
+// out_coff/out_clen[r] = the contig's byte span inside buf (the caller
+// slices the strings; C never allocates). Skip semantics mirror
+// ingest/vcf.py parse_record_lines exactly: '#' lines and lines with
+// fewer than 10 tab-separated fields are skipped silently, records
+// whose FORMAT lacks a GT token are skipped silently, and records with
+// fewer than n_samples sample columns are skipped and counted into
+// *n_short (the caller warns once). A POS field that is not a plain
+// (optionally signed) integer sets *n_reject and aborts the batch —
+// the caller falls back to the Python parser so malformed input raises
+// exactly the error the serial path raises.
+// Returns the number of accepted records (rows of `out` filled).
+int64_t vcf_parse_block(const char* buf, int64_t len, int64_t n_samples,
+                        int64_t max_records, int8_t* out, int64_t* out_pos,
+                        int64_t* out_coff, int64_t* out_clen,
+                        int64_t* n_short, int64_t* n_reject) {
+    int64_t r = 0;
+    const char* p = buf;
+    const char* bend = buf + len;
+    *n_short = 0;
+    *n_reject = 0;
+    while (p < bend && r < max_records) {
+        const char* line = p;
+        const char* nl = (const char*)memchr(p, '\n', bend - p);
+        const char* lend = nl ? nl : bend;
+        p = nl ? nl + 1 : bend;
+        if (lend > line && lend[-1] == '\r') --lend;  // CRLF files raw
+        if (lend == line) continue;                   // empty line
+        if (line[0] == '#') continue;                 // header
+        // Starts of the first 10 tab-separated fields.
+        const char* f[10];
+        f[0] = line;
+        int nf = 1;
+        for (const char* q = line; q < lend && nf < 10; ++q) {
+            if (*q == '\t') f[nf++] = q + 1;
+        }
+        if (nf < 10) continue;                        // < 10 fields
+        // POS (field 1) — a plain integer, or punt the whole batch.
+        const char* d = f[1];
+        const char* posend = f[2] - 1;                // the tab after it
+        int64_t pos = 0;
+        int neg = 0, any = 0;
+        if (d < posend && (*d == '-' || *d == '+')) {
+            neg = (*d == '-');
+            ++d;
+        }
+        for (; d < posend && *d >= '0' && *d <= '9'; ++d) {
+            pos = pos * 10 + (*d - '0');
+            any = 1;
+        }
+        if (!any || d != posend) {
+            *n_reject = 1;
+            return r;
+        }
+        if (neg) pos = -pos;
+        // FORMAT (field 8): locate the GT token among ':'-separated.
+        const char* fm = f[8];
+        const char* fmend = f[9] - 1;
+        int64_t gt_index = -1, tok = 0;
+        for (const char* t = fm; t <= fmend; ++tok) {
+            const char* te = t;
+            while (te < fmend && *te != ':') ++te;
+            if (te - t == 2 && t[0] == 'G' && t[1] == 'T') {
+                gt_index = tok;
+                break;
+            }
+            if (te >= fmend) break;
+            t = te + 1;
+        }
+        if (gt_index < 0) continue;                   // no genotypes
+        int64_t got = parse_samples(f[9], lend, gt_index,
+                                    out + r * n_samples, n_samples);
+        if (got < n_samples) {
+            ++*n_short;
+            continue;
+        }
+        out_pos[r] = pos;
+        out_coff[r] = f[0] - buf;
+        out_clen[r] = (f[1] - 1) - f[0];
+        ++r;
+    }
+    if (p < bend && r >= max_records) {
+        // Caller under-sized the output (its bound assumes an accepted
+        // record spans at least n_samples+9 bytes of tabs) — punt the
+        // batch rather than silently dropping the tail records.
+        *n_reject = 1;
+    }
+    return r;
 }
 
 }  // extern "C"

@@ -39,6 +39,21 @@ import jax.numpy as jnp
 from spark_examples_tpu.pipelines import project as P
 
 
+def _store_cache_of(source):
+    """The DecodeCache behind a (possibly wrapped) store-backed source,
+    or None — serve's /stats endpoint reports its accounting when the
+    reference panel was staged from ``store:<dir>``."""
+    seen = 0
+    while source is not None and seen < 8:  # bounded unwrap
+        cache = getattr(source, "cache", None)
+        if cache is not None and hasattr(cache, "stats"):
+            return cache
+        source = getattr(source, "inner", None) or getattr(
+            source, "store", None)
+        seen += 1
+    return None
+
+
 class ProjectionEngine:
     """A loaded model + staged reference panel + compiled batch step.
 
@@ -61,6 +76,11 @@ class ProjectionEngine:
         self._install_model(model)
         P.check_reference_panel(model, source_ref)  # before any staging
         self._panel_ids = list(source_ref.sample_ids)
+        # Staging from a dataset store rides its tiered read path (and,
+        # when armed, the readahead pool). Keep a handle on the decode
+        # cache so /stats can report the staging hit/miss/eviction
+        # accounting (the serve-cold-start story in numbers).
+        self._panel_cache = _store_cache_of(source_ref)
         # Stage the panel once: dense int8 blocks, device-resident for
         # the life of the server (the whole point — no per-request
         # panel re-stream). Block shapes are fixed across requests, so
@@ -91,6 +111,14 @@ class ProjectionEngine:
         self._colmean = jax.device_put(
             np.asarray(model.colmean, np.float32))
         self._grand = jnp.float32(model.grand)
+
+    def store_cache_stats(self) -> dict | None:
+        """DecodeCache accounting of the staged panel's store (hits/
+        misses/evictions/bytes), or None when the panel did not come
+        from a dataset store."""
+        if self._panel_cache is None:
+            return None
+        return self._panel_cache.stats()
 
     @property
     def n_ref(self) -> int:

@@ -59,12 +59,19 @@ def _make_handler(pserver: ProjectionServer):
                 hists = telemetry.metrics_snapshot()["histograms"]
                 lat = hists.get("serve.latency_s", {})
                 rows = hists.get("serve.batch_rows", {})
-                self._reply(200, {
+                payload = {
                     **pserver.stats.snapshot(),
                     "latency_p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
                     "latency_p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
                     "batch_rows_mean": round(rows.get("mean", 0.0), 2),
-                })
+                }
+                # Panel staged from a dataset store: surface the decode
+                # cache's hit/miss/eviction accounting (the cold-start
+                # staging story; absent for non-store panels).
+                store_cache = pserver.engine.store_cache_stats()
+                if store_cache is not None:
+                    payload["store_cache"] = store_cache
+                self._reply(200, payload)
                 return
             self._reply(404, {"error": f"unknown path {self.path!r}"})
 

@@ -49,6 +49,14 @@ class DecodeCache:
         with self._lock:
             return len(self._data)
 
+    def peek(self, key: int) -> np.ndarray | None:
+        """``get`` without accounting or LRU promotion — the readahead
+        pool's "already resident?" probe (a background warmer consulting
+        the cache must not inflate the consumer-facing hit/miss stats
+        or reorder the eviction queue)."""
+        with self._lock:
+            return self._data.get(key)
+
     def get(self, key: int) -> np.ndarray | None:
         with self._lock:
             value = self._data.get(key)

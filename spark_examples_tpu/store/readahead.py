@@ -1,0 +1,113 @@
+"""Background readahead for the store read path: decode ahead of the
+cursor so the store-cold tier runs at store-hit throughput.
+
+Cold store reads pay mmap + first-touch sha256 verify + 2-bit decode
+per chunk, serialized on the consumer thread while the chip (or the
+next pipeline stage) waits. The readahead pool moves that work off the
+critical path: as the streaming loops (``StoreSource.blocks`` /
+``packed_blocks`` / range sources) advance, the next ``depth`` chunks
+are decoded+verified by a small worker pool into the existing
+:class:`~spark_examples_tpu.store.cache.DecodeCache`, so by the time
+the cursor arrives the read is a cache hit. sha256 and the NumPy
+unpack both release the GIL, so warming genuinely overlaps consumer
+work (and other warms).
+
+Error contract — workers never swallow and never crash a thread
+silently: an exception raised while warming chunk ``i`` (an injected
+``store.read`` fault, a real flaky read, a digest mismatch) is held and
+**re-raised in the consumer thread when the cursor reaches chunk i** —
+in order, with the chunk's own resume cursor — so it flows through the
+exact same retry/fail-fast boundary (`ingest/resilient.py`) a
+synchronous read would: transient ``IOError`` s get retried/reopened,
+:class:`~spark_examples_tpu.store.manifest.StoreCorruptError` fails
+fast with the quarantine recorded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from spark_examples_tpu.core import telemetry
+
+# Decode workers per pool: enough to overlap verify+decode with the
+# consumer, few enough that a fleet of open stores doesn't breed
+# threads. Depth (how far ahead to warm) is the operator's knob
+# (--readahead-chunks); this is plumbing width, not policy.
+MAX_WORKERS = 4
+
+
+class ReadaheadPool:
+    """A bounded chunk-warming pool for one store reader.
+
+    ``schedule(key, fn)`` submits ``fn`` (the decode/verify of one
+    chunk) unless that key is already scheduled; ``consume(key)`` is
+    called by the consumer on a cache miss — it waits out an in-flight
+    warm of the same chunk (double-decoding would double-fire the
+    ``store.read`` fault site and waste the work) and returns its
+    value, re-raising the worker's exception if it failed. Keys never
+    scheduled return None and the caller decodes inline. Keys are
+    ``(transport, chunk_index)`` tuples: the dense and packed
+    transports warm different artifacts (a cached decode vs a verified
+    byte map) and must never collide on a bare index.
+    """
+
+    def __init__(self, depth: int, workers: int | None = None):
+        self.depth = max(1, int(depth))
+        self._ex = ThreadPoolExecutor(
+            max_workers=workers or min(self.depth, MAX_WORKERS),
+            thread_name_prefix="store-readahead",
+        )
+        self._futures: dict[tuple, Future] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def schedule(self, key: tuple, fn) -> None:
+        with self._lock:
+            if self._closed or key in self._futures:
+                return
+            if len(self._futures) >= 2 * self.depth:
+                # Backstop against a consumer that skips chunks (range
+                # queries): never hold more than 2x depth of warmed-but-
+                # unconsumed chunks alive.
+                return
+            self._futures[key] = self._ex.submit(fn)
+            telemetry.gauge_set("store.readahead.in_flight",
+                                float(len(self._futures)))
+        telemetry.count("store.readahead.scheduled")
+
+    def consume(self, key: tuple):
+        """The consumer's rendezvous for one warm: the warmed value,
+        the worker's re-raised exception, or None (never scheduled)."""
+        with self._lock:
+            fut = self._futures.pop(key, None)
+            telemetry.gauge_set("store.readahead.in_flight",
+                                float(len(self._futures)))
+        if fut is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            value = fut.result()
+        except BaseException:
+            telemetry.count("store.readahead.errors")
+            raise
+        finally:
+            telemetry.observe("store.readahead.wait_s",
+                              time.perf_counter() - t0)
+        telemetry.count("store.readahead.hits")
+        return value
+
+    def discard(self, key: tuple) -> None:
+        """Drop a pending warm without waiting (a failed-and-retried
+        stream re-schedules from its reopened reader)."""
+        with self._lock:
+            fut = self._futures.pop(key, None)
+        if fut is not None:
+            fut.cancel()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._futures.clear()
+        self._ex.shutdown(wait=False, cancel_futures=True)

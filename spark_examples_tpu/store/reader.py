@@ -47,10 +47,19 @@ DEFAULT_CACHE_BYTES = 256 << 20  # 256 MB of decoded chunks
 
 
 def open_store(path: str, cache_bytes: int = DEFAULT_CACHE_BYTES,
-               verify: bool = True) -> "StoreSource":
-    """Open a compacted store (manifest load + lazy chunk mapping)."""
+               verify: bool = True,
+               readahead_chunks: int = 0) -> "StoreSource":
+    """Open a compacted store (manifest load + lazy chunk mapping).
+
+    ``readahead_chunks > 0`` arms the background readahead pool
+    (store/readahead.py): the streaming loops warm that many chunks
+    ahead of the cursor into the decode cache, so the store-cold tier
+    (mmap + first-touch verify + decode) overlaps consumption instead
+    of serializing in front of it.
+    """
     return StoreSource(path, StoreManifest.load(path),
-                       cache_bytes=cache_bytes, verify=verify)
+                       cache_bytes=cache_bytes, verify=verify,
+                       readahead_chunks=readahead_chunks)
 
 
 class StoreSource:
@@ -59,13 +68,29 @@ class StoreSource:
 
     def __init__(self, root: str, manifest: StoreManifest,
                  cache_bytes: int = DEFAULT_CACHE_BYTES,
-                 verify: bool = True):
+                 verify: bool = True, readahead_chunks: int = 0):
         self.root = root
         self.manifest = manifest
         self.verify = bool(verify)
         self.cache = DecodeCache(cache_bytes)
         self._verified: set[int] = set()
         self._positions: np.ndarray | None = None
+        self._ra = None
+        if readahead_chunks:
+            if readahead_chunks < 0:
+                raise ValueError(
+                    f"readahead_chunks must be >= 0, got {readahead_chunks}"
+                )
+            from spark_examples_tpu.store.readahead import ReadaheadPool
+
+            self._ra = ReadaheadPool(readahead_chunks)
+
+    def close(self) -> None:
+        """Stop the readahead pool (idempotent; streams already yielded
+        stay valid — the pool only warms the cache)."""
+        if self._ra is not None:
+            self._ra.close()
+            self._ra = None
 
     # -- GenotypeSource metadata -------------------------------------------
 
@@ -192,17 +217,61 @@ class StoreSource:
             self._verified.add(idx)
         return m
 
-    def _chunk_dense(self, idx: int) -> np.ndarray:
-        """Dense int8 decode of one chunk, through the decode cache."""
-        cached = self.cache.get(idx)
-        if cached is not None:
-            return cached
+    def _decode_chunk(self, idx: int) -> np.ndarray:
+        """Unconditional map+verify+decode of one chunk into the cache —
+        the cold tier's actual work, shared by the consumer path and the
+        readahead workers (who run it off the critical path)."""
         rec = self.manifest.chunks[idx]
         with telemetry.span("store.chunk_read", cat="store", chunk=idx):
             raw = self._chunk_bytes(idx)
             dense = bitpack.unpack_dosages_np(raw)[:, :rec.width]
         self.cache.put(idx, dense)
         return dense
+
+    def _warm_dense(self, idx: int) -> np.ndarray:
+        """Readahead worker body: decode unless already resident (peek —
+        a background warmer must not touch the consumer-facing hit/miss
+        accounting)."""
+        cached = self.cache.peek(idx)
+        if cached is not None:
+            return cached
+        return self._decode_chunk(idx)
+
+    def _schedule_ahead(self, last_idx: int, packed: bool = False) -> None:
+        """Warm the ``depth`` chunks after ``last_idx`` in the background.
+
+        Dense transport warms full decodes into the cache; the packed
+        transport's cold cost is the first-touch digest verify, so it
+        warms ``_chunk_bytes`` (map + verify) instead. Errors raised by
+        a warm are delivered to the consumer when its cursor reaches the
+        failed chunk (ReadaheadPool.consume), in order."""
+        if self._ra is None:
+            return
+        n_chunks = len(self.manifest.chunks)
+        for j in range(last_idx + 1,
+                       min(last_idx + 1 + self._ra.depth, n_chunks)):
+            if packed:
+                if j in self._verified:
+                    continue
+                self._ra.schedule(("bytes", j),
+                                  lambda j=j: self._chunk_bytes(j))
+            else:
+                if self.cache.peek(j) is not None:
+                    continue
+                self._ra.schedule(("dense", j),
+                                  lambda j=j: self._warm_dense(j))
+
+    def _chunk_dense(self, idx: int) -> np.ndarray:
+        """Dense int8 decode of one chunk, through the decode cache and
+        (when armed) the readahead rendezvous."""
+        cached = self.cache.get(idx)
+        if cached is not None:
+            return cached
+        if self._ra is not None:
+            got = self._ra.consume(("dense", idx))  # re-raises a failed warm
+            if got is not None:
+                return got
+        return self._decode_chunk(idx)
 
     def read_range(self, lo: int, hi: int) -> np.ndarray:
         """Dense (N, hi-lo) int8 slice of the global variant order —
@@ -249,6 +318,9 @@ class StoreSource:
         for idx, lo, hi, contig in self._grid(block_variants):
             if lo < start_variant:
                 continue
+            covering = self.manifest.chunks_for_range(lo, hi)
+            if covering:
+                self._schedule_ahead(covering[-1][0])
             yield self.read_range(lo, hi), self._meta(idx, lo, hi, contig)
 
     def packed_blocks(self, block_variants: int, start_variant: int = 0):
@@ -267,9 +339,16 @@ class StoreSource:
             if lo < start_variant:
                 continue
             covering = self.manifest.chunks_for_range(lo, hi)
+            if covering:
+                self._schedule_ahead(covering[-1][0], packed=True)
             if len(covering) == 1 and (lo - covering[0][1].start) % vpb == 0:
                 i, rec = covering[0]
-                raw = self._chunk_bytes(i)
+                if self._ra is not None:
+                    warmed = self._ra.consume(("bytes", i))  # re-raises
+                    raw = (warmed if warmed is not None
+                           else self._chunk_bytes(i))
+                else:
+                    raw = self._chunk_bytes(i)
                 b0 = (lo - rec.start) // vpb
                 b1 = bitpack.packed_width(hi - rec.start)
                 pblock = np.ascontiguousarray(raw[:, b0:b1])
@@ -376,6 +455,9 @@ class StoreRangeSource:
                 if local_lo < start_variant:
                     idx += 1
                     continue
+                covering = self.store.manifest.chunks_for_range(lo, hi)
+                if covering:
+                    self.store._schedule_ahead(covering[-1][0])
                 meta = self.store._meta(idx, lo, hi, runs[s][0])
                 yield self.store.read_range(lo, hi), _dc_replace(
                     meta, start=local_lo, stop=hi - self.lo,

@@ -23,6 +23,7 @@ touching data.
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -35,8 +36,40 @@ from spark_examples_tpu.store.manifest import (
 )
 
 
+def _write_chunk(path: str, block: np.ndarray) -> tuple[str, int]:
+    """Pack + hash + (dedupe-aware) write one chunk; returns (digest,
+    width). Runs in a pool worker under ``workers > 1`` — everything
+    here (the native 2-bit pack, sha256 over the packed bytes, the file
+    write) releases the GIL, which is what makes stage B scale."""
+    from spark_examples_tpu.ingest import bitpack
+
+    packed = bitpack.pack_dosages(np.ascontiguousarray(block))
+    data = packed.tobytes()
+    digest = hashing.sha256_bytes(data)
+    fname = os.path.join(path, CHUNK_DIR, f"{digest}.bin")
+    # Dedupe by content address — but a wrong-SIZED file under the
+    # right name is a truncated write (or a quarantined chunk), and
+    # re-running the compaction must heal it, not trust the name.
+    # Same-size bit rot is the read path's job (first-touch digest
+    # verify); healing it means deleting the quarantined file and
+    # re-running this compaction.
+    try:
+        fresh = os.path.getsize(fname) != len(data)
+    except OSError:
+        fresh = True
+    if fresh:
+        tmp = fname + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, fname)
+        telemetry.count("store.compact_bytes", float(len(data)))
+    telemetry.count("store.compact_chunks")
+    return digest, block.shape[1]
+
+
 @telemetry.traced("store.compact", cat="store")
-def compact(path: str, source, chunk_variants: int = 16384) -> StoreManifest:
+def compact(path: str, source, chunk_variants: int = 16384,
+            workers: int = 1) -> StoreManifest:
     """Stream ``source`` into a content-addressed store at ``path``.
 
     ``chunk_variants`` is the catalog granularity: the unit of range
@@ -44,6 +77,13 @@ def compact(path: str, source, chunk_variants: int = 16384) -> StoreManifest:
     divisible by 4 so full chunks stay byte-aligned on the 2-bit grid
     (which is what lets the reader hand out zero-copy packed slices).
     Returns the committed manifest.
+
+    ``workers > 1`` runs the parallel ingest engine (ingest/parallel.py)
+    under the SAME output contract — byte-identical chunks and manifest:
+    stage A fans the parse out where the source allows it (VCF byte
+    ranges, exact-source block stripes), stage B packs + hashes + writes
+    each chunk in a second bounded pool, both reassembled in order. The
+    serial ``workers=1`` path below is the semantic reference.
     """
     from spark_examples_tpu.ingest import bitpack
 
@@ -52,49 +92,59 @@ def compact(path: str, source, chunk_variants: int = 16384) -> StoreManifest:
             f"chunk_variants must be a positive multiple of "
             f"{bitpack.VARIANTS_PER_BYTE}, got {chunk_variants}"
         )
-    n, v = source.n_samples, source.n_variants
+    workers = int(workers)
+    if workers < 1:
+        raise ValueError(f"compact workers must be >= 1, got {workers}")
+    n = source.n_samples
     os.makedirs(os.path.join(path, CHUNK_DIR), exist_ok=True)
 
+    if workers > 1:
+        from spark_examples_tpu.ingest.parallel import (
+            parallel_blocks, parallel_map_ordered,
+        )
+
+        block_iter = parallel_blocks(source, chunk_variants, workers)
+
+        def emit(item):
+            block, meta = item
+            digest, _w = _write_chunk(path, block)
+            return meta, digest
+
+        emitted = parallel_map_ordered(block_iter, emit, workers,
+                                       name="compact-chunk")
+    else:
+        emitted = (
+            (meta, _write_chunk(path, block)[0])
+            for block, meta in source.blocks(chunk_variants)
+        )
+
     records: list[ChunkRecord] = []
-    positions = np.full(v, -1, np.int64)
+    chunk_positions: list[np.ndarray | None] = []
     written = 0  # variants consumed from the stream
-    for block, meta in source.blocks(chunk_variants):
+    for meta, digest in emitted:
         if meta.start != written:
             raise ValueError(
                 f"non-contiguous block stream: expected start {written}, "
                 f"got {meta.start}"
             )
-        packed = bitpack.pack_dosages(np.ascontiguousarray(block))
-        data = packed.tobytes()
-        digest = hashing.sha256_bytes(data)
-        fname = os.path.join(path, CHUNK_DIR, f"{digest}.bin")
-        # Dedupe by content address — but a wrong-SIZED file under the
-        # right name is a truncated write (or a quarantined chunk), and
-        # re-running the compaction must heal it, not trust the name.
-        # Same-size bit rot is the read path's job (first-touch digest
-        # verify); healing it means deleting the quarantined file and
-        # re-running this compaction.
-        try:
-            fresh = os.path.getsize(fname) != len(data)
-        except OSError:
-            fresh = True
-        if fresh:
-            tmp = fname + f".tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, fname)
-            telemetry.count("store.compact_bytes", float(len(data)))
-        telemetry.count("store.compact_chunks")
         pos_lo = pos_hi = -1
         if meta.positions is not None and len(meta.positions):
-            positions[meta.start:meta.stop] = meta.positions
+            chunk_positions.append(np.asarray(meta.positions, np.int64))
             pos_lo = int(meta.positions[0])
             pos_hi = int(meta.positions[-1])
+        else:
+            chunk_positions.append(None)
         records.append(ChunkRecord(
             start=meta.start, stop=meta.stop, contig=meta.contig,
             digest=digest, pos_lo=pos_lo, pos_hi=pos_hi,
         ))
         written = meta.stop
+    # The declared count is consulted AFTER the stream: a completed full
+    # pass caches it on parse-counting sources (VcfSource), so the
+    # compaction never pays the serial pre-scan pass the reader would
+    # otherwise run up front — a pure serial term the parallel engine
+    # could not have absorbed.
+    v = source.n_variants
     if written != v:
         raise ValueError(
             f"source stream ended at {written} of {v} declared variants"
@@ -102,6 +152,10 @@ def compact(path: str, source, chunk_variants: int = 16384) -> StoreManifest:
     if not records:
         raise ValueError("source yielded no variants — nothing to compact")
 
+    positions = np.full(v, -1, np.int64)
+    for rec, cp in zip(records, chunk_positions):
+        if cp is not None:
+            positions[rec.start:rec.stop] = cp
     has_positions = bool((positions >= 0).all())
     positions_digest = None
     if has_positions:

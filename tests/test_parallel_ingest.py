@@ -1,0 +1,517 @@
+"""Parallel ingest engine (ingest/parallel.py), store readahead
+(store/readahead.py), and the K-deep staged device feed
+(ingest/prefetch.py): ordered-reassembly determinism — N-worker parses,
+compactions, and readahead streams must be byte/bit-identical to the
+serial path, including when faults fire inside a pool worker — plus the
+config-time knob validation that keeps nonsense values out of worker
+threads."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults
+from spark_examples_tpu.core.config import IngestConfig
+from spark_examples_tpu.ingest import bitpack
+from spark_examples_tpu.ingest.parallel import (
+    parallel_blocks,
+    parallel_map_ordered,
+    vcf_byte_shards,
+)
+from spark_examples_tpu.ingest.prefetch import stream_to_device
+from spark_examples_tpu.ingest.resilient import (
+    IngestExhaustedError,
+    RetryingSource,
+    RetryPolicy,
+)
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.ingest.synthetic import SyntheticSource
+from spark_examples_tpu.ingest.vcf import VcfSource, write_vcf
+from spark_examples_tpu.store import StoreCorruptError, compact, open_store
+from tests.conftest import random_genotypes
+
+
+def _materialize(source, block_variants, start=0):
+    blocks = [b for b, _ in source.blocks(block_variants, start)]
+    return np.concatenate(blocks, axis=1) if blocks else None
+
+
+def _metas(stream):
+    return [(m.index, m.start, m.stop, m.contig) for _b, m in stream]
+
+
+@pytest.fixture
+def multi_vcf(tmp_path, rng):
+    """A two-contig VCF (chr1 x 53 + chr2 x 19) with tiny forced shards
+    so even the toy file exercises multi-shard reassembly."""
+    import spark_examples_tpu.ingest.parallel as par
+
+    g1 = random_genotypes(rng, 11, 53, 0.1)
+    g2 = random_genotypes(rng, 11, 19, 0.1)
+    p1, p2 = str(tmp_path / "a.vcf"), str(tmp_path / "b.vcf")
+    write_vcf(p1, g1, contig="chr1", start_pos=100)
+    write_vcf(p2, g2, contig="chr2", start_pos=500)
+    header = [ln for ln in open(p1) if ln.startswith("#")]
+    records = [ln for p in (p1, p2) for ln in open(p)
+               if not ln.startswith("#")]
+    multi = str(tmp_path / "multi.vcf")
+    open(multi, "w").writelines(header + records)
+    old = par.VCF_SHARD_BYTES
+    par.VCF_SHARD_BYTES = 1024
+    yield multi, np.concatenate([g1, g2], axis=1)
+    par.VCF_SHARD_BYTES = old
+
+
+# ---------------------------------------------------------------------------
+# The ordered reassembly primitive.
+
+
+def test_parallel_map_ordered_preserves_order():
+    out = list(parallel_map_ordered(range(64), lambda x: x * x, 5))
+    assert out == [x * x for x in range(64)]
+
+
+def test_parallel_map_ordered_propagates_error_in_order():
+    seen = []
+
+    def fn(x):
+        if x == 7:
+            raise RuntimeError("worker died")
+        return x
+
+    with pytest.raises(RuntimeError, match="worker died"):
+        for v in parallel_map_ordered(range(32), fn, 4):
+            seen.append(v)
+    # Every in-order predecessor was delivered before the failure.
+    assert seen == list(range(7))
+
+
+def test_parallel_map_ordered_single_worker_is_plain_map():
+    assert list(parallel_map_ordered(range(5), str, 1)) == list("01234")
+
+
+# ---------------------------------------------------------------------------
+# Parallel parse determinism.
+
+
+def test_vcf_byte_shards_cover_exactly(multi_vcf):
+    path, _g = multi_vcf
+    shards = vcf_byte_shards(path, target_bytes=512)
+    assert len(shards) > 2
+    # Contiguous, non-overlapping, ending at EOF.
+    for (a, b), (c, _d) in zip(shards, shards[1:]):
+        assert b == c and b > a
+    assert shards[-1][1] == os.path.getsize(path)
+
+
+def test_parallel_vcf_blocks_bit_identical(multi_vcf):
+    path, want = multi_vcf
+    serial = list(VcfSource(path).blocks(16))
+    par = list(parallel_blocks(VcfSource(path), 16, 4))
+    assert _metas(serial) == _metas(par)
+    for (b1, m1), (b2, m2) in zip(serial, par):
+        np.testing.assert_array_equal(b1, b2)
+        np.testing.assert_array_equal(m1.positions, m2.positions)
+    np.testing.assert_array_equal(
+        np.concatenate([b for b, _ in par], axis=1), want)
+
+
+def test_parallel_blocks_stripe_mode_bit_identical():
+    src = SyntheticSource(n_samples=9, n_variants=700, seed=3)
+    serial = list(src.blocks(64))
+    par = list(parallel_blocks(src, 64, 4))
+    assert _metas(serial) == _metas(par)
+    for (b1, _), (b2, _) in zip(serial, par):
+        np.testing.assert_array_equal(b1, b2)
+
+
+def test_parallel_blocks_serial_fallback_for_unshardable(multi_vcf, tmp_path):
+    # gzip VCF cannot seek -> byte-range sharding must decline, stream
+    # still correct through the serial fallback.
+    import gzip
+    import shutil
+
+    path, want = multi_vcf
+    gz = str(tmp_path / "m.vcf.gz")
+    with open(path, "rb") as f_in, gzip.open(gz, "wb") as f_out:
+        shutil.copyfileobj(f_in, f_out)
+    got = np.concatenate(
+        [b for b, _ in parallel_blocks(VcfSource(gz), 16, 4)], axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batch_parser_pinned_to_python_on_adversarial_records():
+    """The native batch parser (vcf_parse_block) against the Python
+    record parser on every skip/edge case in one buffer: header lines,
+    short fields, no-GT FORMAT, short sample columns, CRLF, half-calls,
+    multi-allelic dosage capping, missing subfields, contig changes."""
+    from spark_examples_tpu import native
+    from spark_examples_tpu.ingest.parallel import (
+        _parse_vcf_range_py,
+    )
+
+    if native.load() is None:
+        pytest.skip("native codec unavailable")
+    n = 3
+    buf = b"".join([
+        b"##meta\n",
+        b"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tA\tB\tC\n",
+        b"chr1\t100\t.\tA\tC\t.\t.\t.\tGT\t0/1\t1|1\t./.\n",
+        b"chr1\t101\t.\tA\tC\t.\t.\t.\tDP:GT\t3:1/2\t4:0/.\t5\n",  # GT 2nd; C missing subfield
+        b"chr1\t102\t.\tA\tC\t.\t.\t.\tDP\t3\t4\t5\n",  # no GT -> skip
+        b"chr1\t103\tshort\n",  # <10 fields -> skip
+        b"chr1\t104\t.\tA\tC\t.\t.\t.\tGT\t0/0\t1/1\n",  # short columns
+        b"chr2\t50\t.\tA\tC\t.\t.\t.\tGT\t1/1/1\t.\t0|1\r\n",  # CRLF, capped
+    ])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        py = _parse_vcf_range_py(buf, "x.vcf", n, None)
+        nat = native.vcf_parse_block(buf, n)
+    rows, pos, contigs, n_short = nat
+    assert n_short == 1  # the 104 record
+    want_cols = np.concatenate([c for c, _p, _ in py], axis=1)
+    np.testing.assert_array_equal(rows.T, want_cols)
+    np.testing.assert_array_equal(
+        pos, np.concatenate([p for _c, p, _ in py]))
+    # py pieces are per-contig-run; native contigs are per-record.
+    want_contigs = [c for _b, p, c in py for _ in range(len(p))]
+    assert contigs == want_contigs
+
+
+# ---------------------------------------------------------------------------
+# Parallel compaction determinism (the satellite's core claim).
+
+
+def _store_bytes(d):
+    with open(os.path.join(d, "manifest.json"), "rb") as f:
+        manifest = f.read()
+    chunks = {}
+    for name in sorted(os.listdir(os.path.join(d, "chunks"))):
+        with open(os.path.join(d, "chunks", name), "rb") as f:
+            chunks[name] = f.read()
+    return manifest, chunks
+
+
+def test_compact_workers_byte_identical_vcf(multi_vcf, tmp_path):
+    path, _want = multi_vcf
+    d1, d4 = str(tmp_path / "w1"), str(tmp_path / "w4")
+    compact(d1, VcfSource(path), chunk_variants=16, workers=1)
+    compact(d4, VcfSource(path), chunk_variants=16, workers=4)
+    assert _store_bytes(d1) == _store_bytes(d4)
+
+
+def test_compact_workers_byte_identical_synthetic(tmp_path):
+    d1, d4 = str(tmp_path / "w1"), str(tmp_path / "w4")
+    compact(d1, SyntheticSource(n_samples=7, n_variants=333, seed=5),
+            chunk_variants=32, workers=1)
+    compact(d4, SyntheticSource(n_samples=7, n_variants=333, seed=5),
+            chunk_variants=32, workers=4)
+    assert _store_bytes(d1) == _store_bytes(d4)
+
+
+def test_compact_workers_pcoa_bit_identical(multi_vcf, tmp_path):
+    """The acceptance-shaped check at test scale: coords through a
+    4-worker-compacted store == coords through the 1-worker one."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+
+    path, _want = multi_vcf
+    d1, d4 = str(tmp_path / "w1"), str(tmp_path / "w4")
+    compact(d1, VcfSource(path), chunk_variants=16, workers=1)
+    compact(d4, VcfSource(path), chunk_variants=16, workers=4)
+
+    def job(d):
+        return JobConfig(
+            ingest=IngestConfig(source="store", path=d, block_variants=16),
+            compute=ComputeConfig(metric="ibs", num_pc=3),
+        )
+
+    c1 = pcoa_job(job(d1)).coords
+    c4 = pcoa_job(job(d4)).coords
+    np.testing.assert_array_equal(c1, c4)
+
+
+def test_compact_parallel_recovers_injected_worker_fault(multi_vcf, tmp_path):
+    """An io_error fired inside a parse shard worker is retried by the
+    worker under the wrapping retry policy — the compacted store is
+    byte-identical to a clean run."""
+    path, _want = multi_vcf
+    clean, faulty = str(tmp_path / "clean"), str(tmp_path / "faulty")
+    compact(clean, VcfSource(path), chunk_variants=16, workers=4)
+    src = RetryingSource(
+        VcfSource(path),
+        policy=RetryPolicy(max_retries=3, backoff_s=0.001),
+    )
+    with faults.armed(["ingest.block_read:io_error:after=1:max=2"]), \
+            warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        compact(faulty, src, chunk_variants=16, workers=4)
+    assert _store_bytes(clean) == _store_bytes(faulty)
+
+
+def test_compact_parallel_exhaustion_names_inorder_cursor(multi_vcf, tmp_path):
+    """A worker whose retry budget runs out surfaces as
+    IngestExhaustedError with the in-order resume cursor stamped at the
+    reassembly point — never a silent partial store."""
+    path, _want = multi_vcf
+    d = str(tmp_path / "dead")
+    src = RetryingSource(
+        VcfSource(path), policy=RetryPolicy(max_retries=1, backoff_s=0.001),
+    )
+    with faults.armed(["ingest.block_read:io_error:max=0"]), \
+            warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with pytest.raises(IngestExhaustedError) as ei:
+            compact(d, src, chunk_variants=16, workers=4)
+    assert ei.value.cursor >= 0
+    assert "start_variant" in str(ei.value)
+    assert not os.path.exists(os.path.join(d, "manifest.json"))
+
+
+# ---------------------------------------------------------------------------
+# Store readahead.
+
+
+@pytest.fixture
+def store_dir(tmp_path, genotypes):
+    src = ArraySource(genotypes, contig="chr9",
+                      positions=np.arange(1000, 1211, dtype=np.int64))
+    d = str(tmp_path / "store")
+    compact(d, src, chunk_variants=32)
+    return d
+
+
+def test_readahead_stream_bit_identical(store_dir, genotypes):
+    plain = open_store(store_dir)
+    ra = open_store(store_dir, readahead_chunks=3)
+    try:
+        for bv in (16, 32, 50, 128):
+            np.testing.assert_array_equal(
+                _materialize(plain, bv), _materialize(ra, bv))
+            np.testing.assert_array_equal(
+                _materialize(ra, bv), genotypes)
+    finally:
+        ra.close()
+
+
+def test_readahead_packed_transport_bit_identical(store_dir, genotypes):
+    ra = open_store(store_dir, readahead_chunks=2)
+    try:
+        cols = []
+        for pb, m in ra.packed_blocks(32):
+            cols.append(bitpack.unpack_dosages_np(pb)[:, :m.stop - m.start])
+        np.testing.assert_array_equal(
+            np.concatenate(cols, axis=1), genotypes)
+    finally:
+        ra.close()
+
+
+def test_readahead_warms_cache_ahead(store_dir):
+    st = open_store(store_dir, readahead_chunks=4)
+    try:
+        stream = st.blocks(32)
+        next(stream)  # first block consumed -> warms are in flight
+        # Drain the stream; by the end every chunk went through the
+        # cache exactly once and the pool reported activity.
+        for _ in stream:
+            pass
+        from spark_examples_tpu.core import telemetry
+
+        assert telemetry.counter_value("store.readahead.scheduled") > 0
+    finally:
+        st.close()
+
+
+def test_readahead_worker_ioerror_rides_retry_boundary(store_dir, genotypes):
+    """An injected store.read io_error that fires inside a READAHEAD
+    worker is re-raised at the consumer's cursor and recovered by the
+    ordinary retry/reopen boundary — stream bit-identical."""
+    src = RetryingSource(
+        open_store(store_dir, readahead_chunks=3),
+        policy=RetryPolicy(max_retries=3, backoff_s=0.001),
+        reopen=lambda: open_store(store_dir, readahead_chunks=3),
+    )
+    with faults.armed(["store.read:io_error:after=2:max=2"]), \
+            warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        got = _materialize(src, 32)
+    np.testing.assert_array_equal(got, genotypes)
+
+
+def test_readahead_worker_corruption_fails_fast_with_cursor(store_dir):
+    """A truncate fault landing in a readahead worker quarantines and
+    fails the CONSUMER fast at that chunk with the resume cursor — the
+    background pool cannot swallow damage."""
+    st = open_store(store_dir, readahead_chunks=3)
+    try:
+        with faults.armed(["store.read:truncate:after=3:max=1:keep=4"]):
+            with pytest.raises(StoreCorruptError) as ei:
+                _materialize(st, 32)
+        assert ei.value.cursor % 32 == 0  # a chunk-start resume cursor
+        assert os.path.exists(os.path.join(store_dir, "quarantine.json"))
+    finally:
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# Serve staging from a store (the readahead + /stats satellite).
+
+
+def test_serve_stages_panel_through_readahead_and_exposes_cache_stats(
+        rng, tmp_path):
+    """The serve cold-start satellite: a panel staged from store:<dir>
+    rides the readahead pool, serves bit-identically to an ArraySource
+    panel, and GET /stats reports the DecodeCache accounting."""
+    import json
+    import urllib.request
+
+    from spark_examples_tpu.core import telemetry
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+    from spark_examples_tpu.serve import ProjectionEngine, ProjectionServer
+    from spark_examples_tpu.serve.http import start_http_server
+
+    telemetry.reset()
+    g_ref = random_genotypes(rng, n=16, v=256, missing_rate=0.1)
+    model = str(tmp_path / "model.npz")
+    pcoa_job(
+        JobConfig(ingest=IngestConfig(block_variants=64),
+                  compute=ComputeConfig(metric="ibs", num_pc=3),
+                  model_path=model),
+        source=ArraySource(g_ref),
+    )
+    d = str(tmp_path / "panel_store")
+    compact(d, ArraySource(g_ref), chunk_variants=64)
+
+    plain = ProjectionEngine(model, ArraySource(g_ref), block_variants=64)
+    assert plain.store_cache_stats() is None  # non-store panels: absent
+
+    scheduled_before = telemetry.counter_value("store.readahead.scheduled")
+    engine = ProjectionEngine(model, open_store(d, readahead_chunks=2),
+                              block_variants=64)
+    assert telemetry.counter_value(
+        "store.readahead.scheduled") > scheduled_before
+    stats = engine.store_cache_stats()
+    assert stats is not None and {"hits", "misses", "evictions"} <= set(stats)
+
+    q = random_genotypes(rng, n=1, v=256, missing_rate=0.1)[0]
+    np.testing.assert_array_equal(
+        plain.project_batch(q[None, :]), engine.project_batch(q[None, :]))
+
+    server = ProjectionServer(engine, max_linger_s=0.001).start()
+    http = start_http_server(server, port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/stats", timeout=30) as r:
+            payload = json.load(r)
+        assert "store_cache" in payload
+        assert payload["store_cache"]["misses"] >= 1
+    finally:
+        http.shutdown()
+        server.close()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# K-deep staged device feed.
+
+
+def test_staged_device_feed_bit_identical(genotypes):
+    src = ArraySource(genotypes)
+    got = []
+    for dev, m in stream_to_device(src, 64, prefetch=3):
+        block = np.asarray(dev)
+        assert block.shape[1] == 64  # shape-stable padding survived
+        got.append(block[:, : m.stop - m.start])
+    np.testing.assert_array_equal(np.concatenate(got, axis=1), genotypes)
+
+
+def test_staged_device_feed_packed_bit_identical(genotypes):
+    got = []
+    for dev, m in stream_to_device(ArraySource(genotypes), 64,
+                                   prefetch=2, pack=True):
+        dense = bitpack.unpack_dosages_np(np.asarray(dev))
+        got.append(dense[:, : m.stop - m.start])
+    np.testing.assert_array_equal(np.concatenate(got, axis=1), genotypes)
+
+
+def test_staging_ring_recycles_and_pads_correctly():
+    """The staging producer at ring level: slabs recycle through the
+    bounded pool, every staged block carries ITS variants (tail padded
+    with MISSING), and releasing a slab unblocks the producer."""
+    from spark_examples_tpu.ingest.prefetch import _produce_host_blocks
+
+    src = SyntheticSource(n_samples=8, n_variants=1000, seed=9)
+    want = _materialize(src, 128)
+    got = []
+    slabs = set()
+    for host, slot, meta in _produce_host_blocks(
+        src, 128, 0, 2, 1, False, None, staging=True,
+    ):
+        assert slot is not None and host is slot.buf
+        assert host.shape[1] == 128
+        w = meta.stop - meta.start
+        got.append(host[:, :w].copy())  # consume before recycling
+        assert (host[:, w:] == -1).all()  # MISSING tail pad
+        slabs.add(id(slot.buf))
+        slot.release()
+    np.testing.assert_array_equal(np.concatenate(got, axis=1), want)
+    # Bounded ring: far fewer slabs than blocks => recycling happened.
+    assert len(slabs) < len(got)
+
+
+def test_staging_disabled_on_cpu_targets(genotypes):
+    """CPU device_put is zero-copy (the returned array aliases the host
+    buffer), so the device feed must run UNSTAGED there — holding every
+    yielded block while the stream advances stays corruption-free."""
+    src = SyntheticSource(n_samples=8, n_variants=2048, seed=9)
+    want = _materialize(src, 128)
+    held = list(stream_to_device(src, 128, prefetch=2))
+    got = np.concatenate(
+        [np.asarray(b)[:, : m.stop - m.start] for b, m in held], axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_staged_feed_abandonment_stops_producer(genotypes):
+    it = stream_to_device(ArraySource(genotypes), 32, prefetch=2)
+    next(it)
+    it.close()  # must not hang or leak a blocked producer
+
+
+# ---------------------------------------------------------------------------
+# Config-time knob validation (the friendly-errors satellite).
+
+
+@pytest.mark.parametrize("field, value", [
+    ("prefetch_blocks", 0),
+    ("prefetch_blocks", -1),
+    ("prefetch_blocks", 1 << 20),
+    ("ingest_workers", 0),
+    ("ingest_workers", -4),
+    ("ingest_workers", 100_000),
+    ("readahead_chunks", -1),
+    ("store_cache_mb", -1),
+    ("block_variants", 0),
+    ("splits_per_contig", 0),
+    ("io_retries", -1),
+])
+def test_ingest_knobs_rejected_at_config_time(field, value):
+    with pytest.raises(ValueError, match=field):
+        IngestConfig(**{field: value})
+
+
+def test_ingest_knob_zero_means_off_where_documented():
+    cfg = IngestConfig(readahead_chunks=0, store_cache_mb=0, io_retries=0)
+    assert cfg.readahead_chunks == 0
+
+
+def test_compact_rejects_nonpositive_workers(tmp_path, genotypes):
+    with pytest.raises(ValueError, match="workers"):
+        compact(str(tmp_path / "s"), ArraySource(genotypes),
+                chunk_variants=32, workers=0)

@@ -143,6 +143,18 @@ def test_core_names_present():
         "store.verify_failures",
         "store.quarantined",
         "store.cache_bytes",
+        # parallel ingest engine + readahead + K-deep device feed
+        # (this PR's instrumentation contract)
+        "ingest.parallel_shards",
+        "ingest.reassembly_wait_s",
+        "prefetch.stage_wait_s",
+        "prefetch.transfer_wait_s",
+        "prefetch.transfers_in_flight",
+        "store.readahead.scheduled",
+        "store.readahead.hits",
+        "store.readahead.errors",
+        "store.readahead.wait_s",
+        "store.readahead.in_flight",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution
