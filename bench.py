@@ -1065,6 +1065,40 @@ def chaos_streamed(store: str, want_coords: np.ndarray) -> dict:
     }
 
 
+def bench_chaos_soak() -> dict:
+    """``--chaos-soak``: the seeded randomized fault schedule
+    (tools/soak.py) — 25 iterations of one randomized
+    kill/io_error/delay/truncate spec per round over every registered
+    fault site, against the store-backed gram pipeline (retry +
+    readahead + heal + checkpoint sites), the projection server, and
+    supervised CLI kill-resume rounds. Invariants per round:
+    bit-identical results, completion inside the watchdog budget, no
+    leaked threads, quarantine+heal bookkeeping consistent. Any
+    violation surfaces as a one-line seed+site repro."""
+    import shutil
+
+    from tools.soak import SoakConfig, run_soak
+
+    # Rooted under the bench cache and removed on a clean soak; kept
+    # in place on a violation so the SOAK-REPRO line has its fixture.
+    workdir = os.path.join(CACHE, "chaos_soak")
+    shutil.rmtree(workdir, ignore_errors=True)
+    t0 = time.perf_counter()
+    report = run_soak(SoakConfig(
+        workdir=workdir, iterations=25, seed=20260803, include_kill=True,
+    ))
+    if report.ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    d = report.to_json()
+    d["soak_s"] = round(time.perf_counter() - t0, 1)
+    log(f"chaos soak: {d['iterations']} iterations in {d['soak_s']}s — "
+        f"ok={d['ok']} healed={d['healed']} retries={d['retries']} "
+        f"faults_fired={d['faults_fired']}")
+    for line in d["violations"]:
+        log(line)
+    return d
+
+
 def check_structure(coords: np.ndarray) -> float:
     """Planted ancestry must be recovered (guards against a fast wrong
     answer)."""
@@ -1201,6 +1235,13 @@ def main() -> None:
             log(f"chaos FAILED: {e!r}")
             configs["chaos"] = {"error": repr(e)}
 
+    if "--chaos-soak" in sys.argv:
+        try:
+            configs["chaos_soak"] = bench_chaos_soak()
+        except Exception as e:
+            log(f"chaos-soak FAILED: {e!r}")
+            configs["chaos_soak"] = {"error": repr(e)}
+
     if "--serve" in sys.argv:
         try:
             configs["serve"] = bench_serve(store)
@@ -1264,6 +1305,14 @@ def main() -> None:
         headline["chaos_ok"] = configs["chaos"].get(
             "coords_bit_identical", False
         )
+    if "chaos_soak" in configs and "error" not in configs["chaos_soak"]:
+        soak = configs["chaos_soak"]
+        headline["chaos_soak_ok"] = bool(soak["ok"])
+        headline["chaos_soak_iterations"] = soak["iterations"]
+        headline["chaos_soak_healed"] = soak["healed"]
+        headline["chaos_soak_faults_fired"] = soak["faults_fired"]
+        if soak["violations"]:
+            headline["chaos_soak_repro"] = soak["violations"][0]
     if "serve" in configs and "error" not in configs["serve"]:
         headline["serve_sustained_qps"] = configs["serve"]["sustained_qps"]
         headline["serve_p99_ms"] = configs["serve"]["latency_p99_ms"]

@@ -131,6 +131,32 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "a background pool into the decode cache, so the "
                    "store-cold tier runs at store-hit throughput "
                    "(0 disables; see README 'Performance tuning')")
+    g.add_argument("--store-replicas", nargs="*", default=[],
+                   metavar="DIR",
+                   help="peer store directories holding content-"
+                   "addressed copies of the chunks: a chunk that fails "
+                   "its digest verify is healed in place from a "
+                   "replica (else from the manifest's recorded origin) "
+                   "instead of failing the run (see README 'Failure "
+                   "modes & recovery')")
+    s = p.add_argument_group("supervision")
+    s.add_argument("--supervise", action="store_true",
+                   help="run this job as a supervised, crash-resumable "
+                   "unit of work: a child process streams under a "
+                   "heartbeat watchdog, and a crash, kill, hang, or "
+                   "stall restarts it from the latest sha256-verified "
+                   "checkpoint (pair with --checkpoint-dir/"
+                   "--checkpoint-every-blocks so restarts resume "
+                   "instead of recomputing)")
+    s.add_argument("--supervise-max-restarts", type=int, default=3,
+                   help="restarts before the supervisor gives up and "
+                   "exits with the last failure")
+    s.add_argument("--supervise-stall-timeout", type=float, default=60.0,
+                   help="seconds of frozen progress (heartbeats alive, "
+                   "no forward motion) before the watchdog kills and "
+                   "restarts; the effective budget never drops below "
+                   "50 block-periods of the job's own reported block "
+                   "p95")
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
@@ -219,6 +245,7 @@ def _job_from_args(args) -> JobConfig:
             seed=args.seed,
             splits_per_contig=args.splits_per_contig,
             ingest_workers=args.ingest_workers,
+            store_replicas=list(args.store_replicas),
             maf=args.maf,
             max_missing=args.max_missing,
             ld_r2=args.ld_prune_r2,
@@ -406,6 +433,29 @@ def main(argv: list[str] | None = None) -> int:
                        "verification, and decode caching; must be a "
                        "multiple of 4)")
 
+    p_store = sub.add_parser(
+        "store",
+        help="dataset-store maintenance. `store heal --path <dir>`: "
+        "repair every quarantined chunk in place — a verified copy "
+        "from a --replica dir, else a re-compaction of the chunk's "
+        "origin span recorded in the manifest — re-verify against the "
+        "content address, and clear the quarantine ledger entries that "
+        "healed",
+    )
+    p_store.add_argument("verb", choices=["heal"],
+                         help="maintenance action")
+    p_store.add_argument("--path", required=True,
+                         help="the store directory")
+    p_store.add_argument("--replica", action="append", default=[],
+                         metavar="DIR",
+                         help="peer store directory to copy verified "
+                         "chunks from (repeatable; tried before origin "
+                         "re-compaction)")
+    p_store.add_argument("--verify-all", action="store_true",
+                         help="re-hash EVERY chunk against its content "
+                         "address (not just the quarantine ledger) and "
+                         "heal whatever fails")
+
     p_cov = sub.add_parser("coverage",
                            help="per-base read coverage over ranges "
                            "(the SearchReads example tier)")
@@ -424,6 +474,20 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "coverage":
         return _run_coverage(args)
+    if args.command == "store":
+        return _run_store_admin(args)
+    if getattr(args, "supervise", False):
+        # The supervision layer: re-invoke this same command (flag
+        # stripped) as a watched child and restart it on crash/hang/
+        # stall — BEFORE any jax import, so the parent stays a light
+        # watchdog that never holds a device.
+        from spark_examples_tpu.core.supervisor import supervise_cli
+
+        return supervise_cli(
+            list(argv) if argv is not None else sys.argv[1:],
+            max_restarts=args.supervise_max_restarts,
+            stall_timeout_s=args.supervise_stall_timeout,
+        )
     if args.command == "pca" and args.metric != "shared-alt":
         parser.error(
             f"pca computes the shared-alt similarity by definition; "
@@ -466,6 +530,13 @@ def main(argv: list[str] | None = None) -> int:
     # trace behind.
     with contextlib.ExitStack() as stack:
         stack.enter_context(profiling.trace(getattr(args, "trace_dir", None)))
+        # Supervised child? Start the heartbeat the parent watchdog
+        # reads (no-op unless the env names a heartbeat path).
+        from spark_examples_tpu.core.supervisor import maybe_start_heartbeat
+
+        hb = maybe_start_heartbeat()
+        if hb is not None:
+            stack.callback(hb.stop)
         if job.telemetry.dir:
             telemetry.configure(dir=job.telemetry.dir,
                                 trace_events=job.telemetry.trace_events)
@@ -685,7 +756,7 @@ def _dispatch(args, parser, job, J, build_source) -> int:
     elif args.command == "ingest":
         import time as _time
 
-        from spark_examples_tpu.store import compact
+        from spark_examples_tpu.store import compact, origin_from_ingest
 
         if not job.output_path:
             parser.error("ingest requires --output-path (the store "
@@ -694,7 +765,9 @@ def _dispatch(args, parser, job, J, build_source) -> int:
         t0 = _time.perf_counter()
         manifest = compact(job.output_path, src,
                            chunk_variants=args.chunk_variants,
-                           workers=job.ingest.ingest_workers)
+                           workers=job.ingest.ingest_workers,
+                           origin=origin_from_ingest(job.ingest,
+                                                     args.chunk_variants))
         dt = _time.perf_counter() - t0
         dense_mb = manifest.n_samples * manifest.n_variants / 1e6
         print(
@@ -814,6 +887,29 @@ def _run_serve(args, parser, job, build_source) -> int:
                 http.shutdown()
     finally:
         server.close()
+    return 0
+
+
+def _run_store_admin(args) -> int:
+    """The ``store`` maintenance subcommand (currently: ``heal``).
+    Prints the heal report as JSON; exit 0 iff nothing is left damaged."""
+    from spark_examples_tpu.store.heal import heal
+
+    report = heal(args.path, replicas=tuple(args.replica),
+                  verify_all=args.verify_all)
+    print(json.dumps(report, sort_keys=True))
+    if report["failed"]:
+        print(
+            f"store heal: {len(report['failed'])} chunk(s) could not be "
+            "healed (no replica holds them and the origin no longer "
+            "reproduces them) — restore the files or re-run the "
+            "compaction",
+            file=sys.stderr,
+        )
+        return 1
+    if report["healed"]:
+        print(f"store heal: {len(report['healed'])} chunk(s) healed and "
+              "re-verified; quarantine ledger cleared", file=sys.stderr)
     return 0
 
 

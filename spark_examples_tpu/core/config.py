@@ -116,6 +116,11 @@ class IngestConfig:
     # decode cache, turning the store-cold tier into store-hit
     # throughput. 0 disables.
     readahead_chunks: int = 2
+    # Peer store directories holding content-addressed chunk copies
+    # (store/heal.py): a chunk failing its digest verify is healed in
+    # place from a replica (else from the manifest's recorded origin)
+    # instead of failing the run.
+    store_replicas: list[str] = field(default_factory=list)
 
     def __post_init__(self):
         # Knob validation AT CONFIG TIME — the ingest pipeline runs its

@@ -49,7 +49,24 @@ Sites instrumented in production code:
                             mapped — ``io_error`` exercises the
                             RetryingSource boundary, ``truncate``
                             corrupts the chunk against its recorded
-                            digest (quarantine must catch it)
+                            digest (heal-or-quarantine must catch it)
+``store.readahead.decode``  per background chunk warm, inside the
+                            readahead pool worker (store/readahead.py)
+                            — a worker-thread failure must be held and
+                            re-raised at the consumer's cursor, never
+                            swallowed or thread-fatal
+``prefetch.transfer_wait``  per staging-slab retire in the K-deep
+                            device feed (ingest/prefetch.py), fired
+                            before the transfer-completion wait —
+                            ``delay`` is a stalled host->device link at
+                            retire time, ``io_error`` a failed transfer
+                            completion (job resumes from checkpoint)
+``supervisor.heartbeat``    per heartbeat write in a supervised child
+                            (core/supervisor.py) — ``delay`` freezes
+                            the heartbeat so the watchdog must detect
+                            the hang and restart; ``io_error`` fails
+                            one write (tolerated, warned, never fatal
+                            to the job thread)
 ==========================  ====================================================
 
 Env grammar (``;``-separated specs, ``:``-separated fields)::
@@ -88,6 +105,9 @@ SITES = (
     "device.put",
     "serve.request",
     "store.read",
+    "store.readahead.decode",
+    "prefetch.transfer_wait",
+    "supervisor.heartbeat",
 )
 
 # Distinctive exit code for the "kill" kind so tests can tell an injected

@@ -111,6 +111,12 @@ NAMES: dict[str, tuple[str, str]] = {
         "one chunk through the store read path: fault site + mmap + "
         "first-touch digest verify + 2-bit decode (or decode-cache hit)",
     ),
+    "store.heal": (
+        "span",
+        "one in-place chunk repair (store/heal.py): verified copy from "
+        "a replica dir, else re-compaction of the chunk's origin span — "
+        "both digest-checked against the content address before install",
+    ),
     # -- instant events ---------------------------------------------------
     "fault": ("event", "a fault-injection spec fired (args: site, kind)"),
     "stream.snapshot": (
@@ -237,7 +243,45 @@ NAMES: dict[str, tuple[str, str]] = {
     "store.quarantined": (
         "counter",
         "corrupt chunks recorded in the store's quarantine.json (the "
-        "operator-facing recovery list; never silently skipped)",
+        "operator-facing recovery list; never silently skipped) — only "
+        "after every heal route failed",
+    ),
+    "store.healed": (
+        "counter",
+        "corrupt chunks repaired in place (replica copy or origin "
+        "re-compaction, digest-verified) instead of failing the run — "
+        "healed incidents also count store.verify_failures, so "
+        "healed/verify_failures is the self-healing rate",
+    ),
+    "supervisor.restarts": (
+        "counter",
+        "supervised-child restarts (crash, injected kill, or watchdog "
+        "hang/stall kill) — each resumes from the latest verified "
+        "checkpoint; a clean supervised run counts 0",
+    ),
+    "supervisor.stalls": (
+        "counter",
+        "watchdog interventions: heartbeats stopped arriving or "
+        "arrived with frozen progress past the stall budget, and the "
+        "child was killed for restart",
+    ),
+    "supervisor.heartbeats": (
+        "counter",
+        "heartbeat files written by this supervised child (the "
+        "liveness/progress signal core/supervisor.py's watchdog reads)",
+    ),
+    "serve.worker_restarts": (
+        "counter",
+        "projection-server batching-worker recoveries: an unexpected "
+        "worker-loop failure or thread death was caught and the worker "
+        "restarted WITHOUT dropping admitted requests (health degrades "
+        "for the cooloff window)",
+    ),
+    "serve.breaker_open": (
+        "counter",
+        "store-read circuit-breaker trips in the serve panel path: "
+        "repeated staging failures opened the breaker and the server "
+        "entered cached-panel-only mode (still serving, degraded)",
     ),
     # -- gauges -----------------------------------------------------------
     "prefetch.queue_depth": (
@@ -250,6 +294,13 @@ NAMES: dict[str, tuple[str, str]] = {
         "gauge",
         "admitted-but-unanswered requests in the projection server "
         "(queued + in the current batch); max is the realized backlog",
+    ),
+    "serve.health": (
+        "gauge",
+        "the serving health state machine as a number (0 healthy, "
+        "1 degraded, 2 draining) — published on every transition so "
+        "the exported timeline shows when and how long the server was "
+        "degraded; /healthz reports the same state as a string",
     ),
     "store.cache_bytes": (
         "gauge",
@@ -416,8 +467,14 @@ class Histogram:
 # Process-wide state. One lock guards everything: per-event cost is a
 # dict update — noise against the block compute the events describe —
 # and sites fire from both the main thread and the prefetch producer.
+# REENTRANT on purpose: the SIGTERM crash-flush handler runs export()
+# on the main thread at an arbitrary bytecode boundary — including
+# inside a `with _lock:` of a hot-path count()/observe() — and a plain
+# Lock would deadlock the dying process there. Re-entry can observe a
+# half-recorded histogram (count bumped, sum not yet); for a final
+# best-effort flush that is noise, for a hang it would be fatal.
 
-_lock = threading.Lock()
+_lock = threading.RLock()
 _T0 = time.perf_counter()  # trace timestamp epoch (per process)
 _START_UNIX = time.time()  # wall-clock process start (summary staleness)
 
@@ -456,11 +513,76 @@ def configure(dir: str | None = None, trace_events: bool = True) -> None:
     Metrics are always collected; this sets where :func:`export` writes
     and whether spans buffer Chrome trace events (``trace_events=False``
     keeps ``metrics.json`` but writes an events-free ``trace.jsonl``).
+
+    Configuring a directory also installs the crash flush (once per
+    process): an ``atexit`` hook and a SIGTERM handler that export
+    whatever has been collected, so a run that dies mid-flight — an
+    unhandled exception, an orchestrator's TERM — still leaves its
+    trace and metrics behind. (SIGKILL / ``os._exit`` cannot be caught;
+    the supervised-run story covers those via checkpoints instead.)
     """
     global _dir, _trace
     with _lock:
         _dir = dir
         _trace = bool(trace_events) and dir is not None
+    if dir is not None:
+        _install_crash_flush()
+
+
+_atexit_installed = False
+_sigterm_installed = False
+
+
+def _crash_flush() -> None:
+    """Best-effort export for abnormal exits: never raises, never
+    prints — a telemetry flush must not be able to mask the real
+    failure or fail an exiting process twice."""
+    try:
+        export()
+    except BaseException:
+        pass
+
+
+def _install_crash_flush() -> None:
+    # Two independent latches: a configure() first called from a
+    # worker thread can only install the atexit half (signal handlers
+    # are main-thread-only) — the SIGTERM half must stay retryable so
+    # a LATER main-thread configure() still installs it, instead of a
+    # single latch silently disabling the flush this satellite exists
+    # to provide.
+    global _atexit_installed, _sigterm_installed
+    if not _atexit_installed:
+        _atexit_installed = True
+        import atexit
+
+        atexit.register(_crash_flush)
+    if _sigterm_installed:
+        return
+    try:
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return  # retry from the next main-thread configure()
+        _sigterm_installed = True
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            _crash_flush()
+            # The handler only ever installs over the DEFAULT
+            # disposition (gate below), so after flushing, restore it
+            # and re-deliver: the exit status still says "terminated
+            # by SIGTERM".
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        # Only take the slot while the disposition is the default —
+        # a handler installed first (or later: serve's drain handler
+        # replaces this one, and its KeyboardInterrupt path unwinds
+        # through the CLI's export callback anyway) keeps its semantics.
+        if prev in (signal.SIG_DFL, None):
+            signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # embedded interpreter / exotic platform: atexit still set
 
 
 def reset() -> None:

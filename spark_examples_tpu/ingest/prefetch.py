@@ -262,6 +262,12 @@ def stream_to_device(
 
     def recycle_oldest():
         dev, slot = pending.popleft()
+        # Chaos site at slab-retire time: a "delay" is a host->device
+        # link that stalls exactly when the ring needs its slab back
+        # (the stage-wait backpressure path must absorb it); an
+        # "io_error" is a transfer that never completes (not retryable
+        # — the job resumes from its checkpoint, like device.put).
+        faults.fire("prefetch.transfer_wait")
         t0 = time.perf_counter()
         dev.block_until_ready()
         telemetry.observe("prefetch.transfer_wait_s",

@@ -211,7 +211,8 @@ def _build_raw_source(cfg: IngestConfig):
         def _open():
             src = open_store(cfg.path,
                              cache_bytes=cfg.store_cache_mb << 20,
-                             readahead_chunks=cfg.readahead_chunks)
+                             readahead_chunks=cfg.readahead_chunks,
+                             replicas=tuple(cfg.store_replicas))
             # --references answered from the catalog's position index
             # (the range-partitioner surface), no chunk touched.
             if cfg.references:

@@ -27,6 +27,12 @@ Layers:
 
 from spark_examples_tpu.serve.cache import ResultCache, genotype_digest
 from spark_examples_tpu.serve.engine import ProjectionEngine
+from spark_examples_tpu.serve.health import (
+    DEGRADED,
+    DRAINING,
+    HEALTHY,
+    CircuitBreaker,
+)
 from spark_examples_tpu.serve.loadgen import run_loadgen
 from spark_examples_tpu.serve.server import (
     DeadlineExceeded,
@@ -36,7 +42,11 @@ from spark_examples_tpu.serve.server import (
 )
 
 __all__ = [
+    "CircuitBreaker",
+    "DEGRADED",
+    "DRAINING",
     "DeadlineExceeded",
+    "HEALTHY",
     "ProjectionEngine",
     "ProjectionServer",
     "ResultCache",

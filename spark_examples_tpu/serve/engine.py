@@ -31,12 +31,15 @@ through its single batching worker.
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 from spark_examples_tpu.pipelines import project as P
+from spark_examples_tpu.serve.health import CircuitBreaker
 
 
 def _store_cache_of(source):
@@ -81,21 +84,93 @@ class ProjectionEngine:
         # cache so /stats can report the staging hit/miss/eviction
         # accounting (the serve-cold-start story in numbers).
         self._panel_cache = _store_cache_of(source_ref)
+        self._source_ref = source_ref
+        # Circuit breaker on the panel's store read path: re-staging
+        # (hot panel refresh after a store heal, a replica catching up)
+        # runs through it, and repeated store failures trip it open —
+        # the server then keeps serving the already-staged panel
+        # (cached-panel-only mode) instead of dying on a broken store.
+        self.breaker = CircuitBreaker()
         # Stage the panel once: dense int8 blocks, device-resident for
         # the life of the server (the whole point — no per-request
         # panel re-stream). Block shapes are fixed across requests, so
         # the compiled update's cache stays at one entry per distinct
-        # staged width (full + ragged tail).
-        self._ref_blocks = []
+        # staged width (full + ragged tail). Init staging is NOT
+        # breaker-guarded: with no cached panel yet there is nothing to
+        # degrade to, so a failure here is correctly fatal.
+        self._ref_blocks, self.n_variants = self._stage_panel(source_ref)
+        if warm:
+            self.warmup()
+
+    def _stage_panel(self, source_ref) -> tuple[list, int]:
+        blocks = []
         n_variants = 0
         for block, meta in source_ref.blocks(self.block_variants):
-            self._ref_blocks.append((jax.device_put(block), meta))
+            blocks.append((jax.device_put(block), meta))
             n_variants = meta.stop
         if n_variants == 0:
             raise ValueError("reference source yielded no variants")
-        self.n_variants = n_variants
-        if warm:
-            self.warmup()
+        return blocks, n_variants
+
+    def restage(self, source_ref=None) -> bool:
+        """Refresh the staged panel from its source through the
+        circuit breaker — the hot path for "the store healed / the
+        replica caught up, pick up the repaired bytes without a
+        restart". Returns True when the panel was re-staged; False in
+        **cached-panel-only mode**: the breaker is open (or this
+        attempt failed and fed it), and the server keeps answering
+        from the panel already on device. The swap is all-or-nothing
+        and identity-checked — a source streaming a different variant
+        count can never replace the panel the model was fitted on."""
+        src = source_ref if source_ref is not None else self._source_ref
+        if not self.breaker.allow():
+            return False
+        try:
+            # Identity BEFORE bytes: the panel is the cohort the model
+            # was fitted on, so the sample ids must match exactly — a
+            # different cohort that happens to stream the same variant
+            # count must never be swapped under the model.
+            if list(src.sample_ids) != self._panel_ids:
+                raise ValueError(
+                    "re-staged source carries different sample ids than "
+                    "the panel the model was fitted on — refusing the "
+                    "swap (fit a new model for a changed panel)"
+                )
+            blocks, n_variants = self._stage_panel(src)
+            if n_variants != self.n_variants:
+                raise ValueError(
+                    f"re-staged panel streams {n_variants} variants, "
+                    f"the staged panel has {self.n_variants} — refusing "
+                    "the swap (fit a new model for a changed panel)"
+                )
+        except Exception as e:
+            self.breaker.record_failure()
+            warnings.warn(
+                f"panel re-stage failed ({e!r}) — serving continues "
+                f"from the cached panel (breaker "
+                f"{self.breaker.state})",
+                RuntimeWarning, stacklevel=2,
+            )
+            return False
+        except BaseException:
+            # SIGINT/SystemExit mid-probe says nothing about the
+            # store: give the half-open probe slot back (else the
+            # breaker wedges open forever) and let it propagate.
+            self.breaker.release_probe()
+            raise
+        self.breaker.record_success()
+        self._ref_blocks = blocks
+        if source_ref is not None:
+            self._source_ref = source_ref
+            self._panel_cache = _store_cache_of(source_ref)
+        return True
+
+    @property
+    def panel_mode(self) -> str:
+        """"staged" (breaker closed) or "cached-only" (the breaker is
+        routing around a failing store — the panel still serves, but
+        re-stages are short-circuited)."""
+        return "staged" if self.breaker.state == "closed" else "cached-only"
 
     def _install_model(self, model: "P.ProjectionModel") -> None:
         """Validate + move a model's statistics to device (init and

@@ -1,0 +1,132 @@
+"""Serving health: the state machine and the store-read circuit breaker.
+
+Availability hardening treats the server's condition as an explicit
+three-state machine rather than a boolean:
+
+- ``healthy`` — serving, all subsystems nominal.
+- ``degraded`` — still serving, but something recovered or is being
+  routed around: the batching worker was restarted within the cooloff
+  window, or the store-read circuit breaker is open and the panel runs
+  in cached-only mode. Load balancers should prefer other replicas;
+  operators should look.
+- ``draining`` — admission closed (SIGTERM / drain()); in-flight
+  requests are being answered, new ones get 503.
+
+The state is surfaced as a string in ``/healthz``, mirrored into the
+``serve.health`` gauge (0/1/2) on every transition so the exported
+timeline shows when and for how long the server was degraded.
+
+:class:`CircuitBreaker` is the classic three-state breaker guarding the
+panel's store read path: ``trip_after`` consecutive failures open it
+(every re-stage attempt then short-circuits without touching the store
+— cached-panel-only mode), and after ``reset_s`` one half-open probe is
+let through; success closes it, failure re-opens the clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from spark_examples_tpu.core import telemetry
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DRAINING = "draining"
+
+HEALTH_CODE = {HEALTHY: 0, DEGRADED: 1, DRAINING: 2}
+
+# How long a worker recovery keeps the server reporting degraded: long
+# enough for a poller to observe it, short enough that one absorbed
+# hiccup doesn't shadow a replica for minutes.
+DEGRADED_COOLOFF_S = 30.0
+
+
+def publish(state: str) -> None:
+    """Mirror a state transition into the ``serve.health`` gauge."""
+    telemetry.gauge_set("serve.health", float(HEALTH_CODE[state]))
+
+
+class CircuitBreaker:
+    """Three-state breaker: closed -> (trip_after consecutive
+    failures) -> open -> (reset_s elapsed) -> half-open probe ->
+    closed on success / open on failure. Thread-safe; time injectable
+    for tests."""
+
+    def __init__(self, trip_after: int = 3, reset_s: float = 30.0,
+                 clock=time.monotonic):
+        self.trip_after = max(1, int(trip_after))
+        self.reset_s = float(reset_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at: float | None = None
+        self._probing = False
+
+    def _state_locked(self) -> str:
+        """THE transition rule — callers hold the lock. One copy, so
+        /healthz's snapshot and the server's health logic can never
+        disagree about what state the breaker is in."""
+        if self._opened_at is None:
+            return "closed"
+        if self._clock() - self._opened_at >= self.reset_s:
+            return "half-open"
+        return "open"
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def allow(self) -> bool:
+        """May the protected operation run now? Open = no; half-open =
+        one probe at a time (a second caller during a live probe is
+        refused, so a slow probe can't stampede the failing store)."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if self._clock() - self._opened_at < self.reset_s:
+                return False
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def release_probe(self) -> None:
+        """Give back a half-open probe slot WITHOUT recording an
+        outcome — for a probe aborted by something that says nothing
+        about the store (SIGINT, SystemExit). Without this, an aborted
+        probe would wedge the breaker: ``allow()`` refuses while a
+        probe is live, and nothing else clears the flag."""
+        with self._lock:
+            self._probing = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self) -> None:
+        tripped = False
+        with self._lock:
+            self._probing = False
+            if self._opened_at is not None:
+                # A failed half-open probe re-opens the clock.
+                self._opened_at = self._clock()
+                return
+            self._failures += 1
+            if self._failures >= self.trip_after:
+                self._opened_at = self._clock()
+                tripped = True
+        if tripped:
+            telemetry.count("serve.breaker_open")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state_locked(),
+                "consecutive_failures": self._failures,
+                "trip_after": self.trip_after,
+                "reset_s": self.reset_s,
+            }

@@ -47,9 +47,12 @@ def _make_handler(pserver: ProjectionServer):
 
         def do_GET(self):  # noqa: N802 (stdlib API)
             if self.path == "/healthz":
+                # The health state machine (serve/health.py): healthy |
+                # degraded (worker recovered recently, or the store
+                # breaker is open and the panel is cached-only) |
+                # draining — plus the evidence behind the verdict.
                 self._reply(200, {
-                    "status": "draining" if pserver._closed else "serving",
-                    "in_flight": pserver.in_flight,
+                    **pserver.health_info(),
                     "n_variants": pserver.engine.n_variants,
                     "n_components": pserver.engine.n_components,
                     "max_batch": pserver.max_batch,
@@ -64,6 +67,8 @@ def _make_handler(pserver: ProjectionServer):
                     "latency_p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
                     "latency_p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
                     "batch_rows_mean": round(rows.get("mean", 0.0), 2),
+                    "worker_restarts": pserver._worker_restarts,
+                    "health": pserver.health,
                 }
                 # Panel staged from a dataset store: surface the decode
                 # cache's hit/miss/eviction accounting (the cold-start

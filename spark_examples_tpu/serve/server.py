@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.serve import health as H
 from spark_examples_tpu.serve.cache import ResultCache, genotype_digest
 from spark_examples_tpu.serve.engine import ProjectionEngine
 
@@ -136,6 +137,11 @@ class ProjectionServer:
         self._idle = threading.Event()  # set while in_flight == 0
         self._idle.set()
         self._worker: threading.Thread | None = None
+        # Worker supervision: recoveries are counted and time-stamped;
+        # the health state machine reports degraded for a cooloff
+        # window after each one (serve/health.py).
+        self._worker_restarts = 0
+        self._last_recovery = 0.0  # monotonic; 0 = never
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -145,7 +151,110 @@ class ProjectionServer:
         self._worker = threading.Thread(
             target=self._run, name="projection-serve-worker", daemon=True)
         self._worker.start()
+        # Publish the backlog gauge BEFORE any request exists: a
+        # supervised server's idle exemption reads it from the
+        # heartbeat, and an unpublished gauge would leave a
+        # pre-first-request idle server looking like a stalled batch
+        # job to the watchdog.
+        telemetry.gauge_set("serve.in_flight", 0)
+        self._publish_health()
         return self
+
+    # -- health state machine ----------------------------------------------
+
+    def _publish_health(self) -> None:
+        """Explicit transition-point publication (start, recovery,
+        restage, drain) — the property also republishes on reads, but
+        an explicit call is not mistakable for a dead statement."""
+        H.publish(self._health_state())
+
+    def _health_state(self) -> str:
+        if self._closed:
+            return H.DRAINING
+        breaker = getattr(self.engine, "breaker", None)
+        if breaker is not None and breaker.state != "closed":
+            return H.DEGRADED
+        if (self._last_recovery
+                and time.monotonic() - self._last_recovery
+                < H.DEGRADED_COOLOFF_S):
+            return H.DEGRADED
+        return H.HEALTHY
+
+    @property
+    def health(self) -> str:
+        """healthy | degraded | draining (serve/health.py). Degraded =
+        the batching worker recovered within the cooloff window, or the
+        panel's store-read circuit breaker is open (cached-panel-only
+        mode) — still serving either way. Every read republishes the
+        ``serve.health`` gauge: several transitions are TIME-driven
+        (cooloff expiry, the breaker's reset window) with no event to
+        hook, so observation is what keeps the exported gauge from
+        reading 'degraded' forever after a long-recovered incident."""
+        state = self._health_state()
+        H.publish(state)
+        return state
+
+    def health_info(self) -> dict:
+        """The /healthz payload beyond the bare state string."""
+        breaker = getattr(self.engine, "breaker", None)
+        return {
+            "status": self.health,
+            "in_flight": self.in_flight,
+            "worker_restarts": self._worker_restarts,
+            "worker_alive": (self._worker is not None
+                             and self._worker.is_alive()),
+            "panel": getattr(self.engine, "panel_mode", "staged"),
+            "breaker": (breaker.snapshot() if breaker is not None
+                        else None),
+        }
+
+    def _note_recovery(self, reason: str) -> None:
+        self._worker_restarts += 1
+        self._last_recovery = time.monotonic()
+        telemetry.count("serve.worker_restarts")
+        self._publish_health()
+        import warnings
+
+        warnings.warn(
+            f"projection server worker recovered ({reason}) — admitted "
+            "requests were NOT dropped; health degrades for "
+            f"{H.DEGRADED_COOLOFF_S:.0f}s",
+            RuntimeWarning, stacklevel=3,
+        )
+
+    def _ensure_worker(self) -> None:
+        """Supervision at admission: a worker thread that died
+        unexpectedly (anything the in-loop recovery net could not
+        catch) is replaced before the request queues — the queue's
+        contents survive, so nothing admitted is dropped. The
+        check-and-start runs under the admission lock: concurrent
+        submits (the HTTP front is one handler thread per request)
+        must not each observe the dead worker and start duplicate
+        replacements — an orphaned extra loop would split batches and
+        survive drain's single join."""
+        w = self._worker
+        if w is None or w.is_alive():
+            return  # cheap unlocked fast path for the healthy case
+        with self._admission_lock:
+            w = self._worker
+            if (w is None or w.is_alive() or self._stop.is_set()
+                    or self._closed):
+                return
+            self._worker = threading.Thread(
+                target=self._run, name="projection-serve-worker",
+                daemon=True)
+            self._worker.start()
+        self._note_recovery("worker thread found dead at admission")
+
+    def restage_panel(self, source_ref=None) -> bool:
+        """Refresh the staged panel through the engine's circuit
+        breaker (serialized against in-flight batches). False =
+        cached-panel-only mode; health reports degraded while the
+        breaker is open."""
+        with self._engine_lock:
+            ok = self.engine.restage(source_ref)
+        self._publish_health()
+        return ok
 
     def __enter__(self) -> "ProjectionServer":
         return self.start()
@@ -164,6 +273,7 @@ class ProjectionServer:
             if self._drained:
                 return self._drain_clean
             self._closed = True
+        self._publish_health()  # -> draining
         clean = True
         with telemetry.span("serve.drain", cat="serve"):
             deadline = time.perf_counter() + timeout
@@ -239,6 +349,7 @@ class ProjectionServer:
         malformed query."""
         if self._closed:
             raise ServerClosed("server is draining/closed")
+        self._ensure_worker()
         g = np.ascontiguousarray(genotypes, dtype=np.int8)
         if g.ndim == 2 and g.shape[0] == 1:
             g = g[0]
@@ -336,13 +447,23 @@ class ProjectionServer:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            batch = self._collect()
-            if batch:
-                try:
-                    self._process(batch)
-                except BaseException as e:  # backstop: answer, don't die
-                    for p in batch:
-                        self._fail(p, e)
+            try:
+                batch = self._collect()
+                if batch:
+                    try:
+                        self._process(batch)
+                    except BaseException as e:  # backstop: answer, don't die
+                        for p in batch:
+                            self._fail(p, e)
+            except BaseException as e:
+                # The supervision net around the loop body itself: a
+                # failure in _collect (or in the failure handling
+                # above) must not silently end the serving thread —
+                # recover in place, leave the queue intact, degrade.
+                if self._stop.is_set():
+                    return
+                self._note_recovery(f"worker loop error: {e!r}")
+                time.sleep(0.005)  # never a hot crash loop
 
     def _collect(self) -> list[_Pending]:
         try:

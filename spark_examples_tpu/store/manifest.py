@@ -32,10 +32,12 @@ from dataclasses import dataclass, field
 from spark_examples_tpu.core.sidecar import load_versioned_sidecar
 from spark_examples_tpu.ingest import bitpack
 
-# Bump when a field is added/renamed/re-semanticized; version 1 is the
-# first (current) schema. load() refuses files from NEWER builds and
-# files without a version rather than guessing.
-STORE_SCHEMA_VERSION = 1
+# Bump when a field is added/renamed/re-semanticized. Version 2 added
+# the optional ``origin`` record (how the store was compacted — the
+# self-healing recipe); version-1 manifests load fine with origin=None.
+# load() refuses files from NEWER builds and files without a version
+# rather than guessing.
+STORE_SCHEMA_VERSION = 2
 
 MANIFEST_NAME = "manifest.json"
 CHUNK_DIR = "chunks"
@@ -100,6 +102,12 @@ class StoreManifest:
     sample_ids: list[str] | None = None
     has_positions: bool = False
     positions_digest: str | None = None
+    # How this store was compacted (an IngestConfig-shaped dict — see
+    # store/heal.py): with it, a corrupt chunk can be re-compacted from
+    # the origin source IN PLACE (content addressing makes the repair
+    # verifiable: the rebuilt bytes must hash to the chunk's name).
+    # None (and every version-1 manifest) means "no healing recipe".
+    origin: dict | None = None
     schema_version: int = STORE_SCHEMA_VERSION
     # Derived indexes (built once in __post_init__, not serialized).
     _starts: list[int] = field(default_factory=list, repr=False)
@@ -163,6 +171,7 @@ class StoreManifest:
             "sample_ids": self.sample_ids,
             "has_positions": self.has_positions,
             "positions_digest": self.positions_digest,
+            "origin": self.origin,
             "chunks": [
                 [c.start, c.stop, c.contig, c.digest, c.pos_lo, c.pos_hi]
                 for c in self.chunks
@@ -213,5 +222,6 @@ class StoreManifest:
             sample_ids=raw.get("sample_ids"),
             has_positions=bool(raw.get("has_positions", False)),
             positions_digest=raw.get("positions_digest"),
+            origin=raw.get("origin"),
             schema_version=version,
         )

@@ -29,7 +29,7 @@ import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
-from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core import faults, telemetry
 
 # Decode workers per pool: enough to overlap verify+decode with the
 # consumer, few enough that a fleet of open stores doesn't breed
@@ -63,6 +63,15 @@ class ReadaheadPool:
         self._lock = threading.Lock()
         self._closed = False
 
+    @staticmethod
+    def _warm(fn):
+        """The worker body: the chaos site fires FIRST so an armed spec
+        fails/stalls the warm inside the pool thread — proving the
+        held-and-re-raised-at-the-cursor error contract (and that a
+        worker death can never leak past `consume` silently)."""
+        faults.fire("store.readahead.decode")
+        return fn()
+
     def schedule(self, key: tuple, fn) -> None:
         with self._lock:
             if self._closed or key in self._futures:
@@ -72,7 +81,7 @@ class ReadaheadPool:
                 # queries): never hold more than 2x depth of warmed-but-
                 # unconsumed chunks alive.
                 return
-            self._futures[key] = self._ex.submit(fn)
+            self._futures[key] = self._ex.submit(self._warm, fn)
             telemetry.gauge_set("store.readahead.in_flight",
                                 float(len(self._futures)))
         telemetry.count("store.readahead.scheduled")

@@ -16,16 +16,20 @@ The read path is tiered:
 **Integrity**: a chunk's filename is its sha256. On first touch per
 reader the bytes are re-hashed against the address (``store.read``
 fault site fires first, so the chaos harness can corrupt or fail the
-read deterministically). A mismatch or truncation is quarantined —
-recorded in ``<store>/quarantine.json``, counted, and raised as
-:class:`StoreCorruptError` naming the resume cursor. Corruption is
-damage, not weather: the retry layer (ingest/resilient.py) retries
-transient ``IOError`` s around this path but never a quarantined chunk.
+read deterministically). A mismatch or truncation first attempts an
+in-place **heal** (store/heal.py): a verified copy from a peer replica
+directory, else a re-compaction of the chunk's origin span when the
+manifest records one — degradation instead of fail-fast. Only when no
+route repairs it is the chunk quarantined — recorded in
+``<store>/quarantine.json`` (atomic, idempotent — store/quarantine.py),
+counted, and raised as :class:`StoreCorruptError` naming the resume
+cursor. Corruption is damage, not weather: the retry layer
+(ingest/resilient.py) retries transient ``IOError`` s around this path
+but never a quarantined chunk.
 """
 
 from __future__ import annotations
 
-import json
 import os
 import warnings
 from dataclasses import replace as _dc_replace
@@ -35,9 +39,10 @@ import numpy as np
 from spark_examples_tpu.core import faults, hashing, telemetry
 from spark_examples_tpu.ingest import bitpack
 from spark_examples_tpu.ingest.source import BlockMeta
+from spark_examples_tpu.store import quarantine as qledger
 from spark_examples_tpu.store.cache import DecodeCache
+from spark_examples_tpu.store.heal import HealError, heal_chunk
 from spark_examples_tpu.store.manifest import (
-    QUARANTINE_NAME,
     ChunkRecord,
     StoreCorruptError,
     StoreManifest,
@@ -48,7 +53,8 @@ DEFAULT_CACHE_BYTES = 256 << 20  # 256 MB of decoded chunks
 
 def open_store(path: str, cache_bytes: int = DEFAULT_CACHE_BYTES,
                verify: bool = True,
-               readahead_chunks: int = 0) -> "StoreSource":
+               readahead_chunks: int = 0,
+               replicas=(), auto_heal: bool = True) -> "StoreSource":
     """Open a compacted store (manifest load + lazy chunk mapping).
 
     ``readahead_chunks > 0`` arms the background readahead pool
@@ -56,10 +62,17 @@ def open_store(path: str, cache_bytes: int = DEFAULT_CACHE_BYTES,
     ahead of the cursor into the decode cache, so the store-cold tier
     (mmap + first-touch verify + decode) overlaps consumption instead
     of serializing in front of it.
+
+    ``replicas`` names peer store directories holding content-addressed
+    copies of the chunks; together with ``auto_heal`` (default on) a
+    chunk that fails its digest verify is repaired in place — from a
+    replica, else by re-compacting its origin span when the manifest
+    records one — instead of failing the read (store/heal.py).
     """
     return StoreSource(path, StoreManifest.load(path),
                        cache_bytes=cache_bytes, verify=verify,
-                       readahead_chunks=readahead_chunks)
+                       readahead_chunks=readahead_chunks,
+                       replicas=replicas, auto_heal=auto_heal)
 
 
 class StoreSource:
@@ -68,10 +81,13 @@ class StoreSource:
 
     def __init__(self, root: str, manifest: StoreManifest,
                  cache_bytes: int = DEFAULT_CACHE_BYTES,
-                 verify: bool = True, readahead_chunks: int = 0):
+                 verify: bool = True, readahead_chunks: int = 0,
+                 replicas=(), auto_heal: bool = True):
         self.root = root
         self.manifest = manifest
         self.verify = bool(verify)
+        self.replicas = tuple(replicas)
+        self.auto_heal = bool(auto_heal)
         self.cache = DecodeCache(cache_bytes)
         self._verified: set[int] = set()
         self._positions: np.ndarray | None = None
@@ -139,37 +155,50 @@ class StoreSource:
     def _chunk_path(self, rec: ChunkRecord) -> str:
         return os.path.join(self.root, rec.filename())
 
+    def _damaged(self, idx: int, rec: ChunkRecord, reason: str,
+                 healed: bool) -> np.ndarray:
+        """A chunk failed its size/existence/digest check: try an
+        in-place heal first (replica copy, else origin re-compaction —
+        store/heal.py), and only quarantine + fail when no route
+        repairs it. ``healed`` guards the retry: a chunk that fails its
+        check AGAIN right after a successful heal is damage the heal
+        cannot fix (e.g. a fault spec re-corrupting every read), and
+        must fail rather than loop."""
+        telemetry.count("store.verify_failures")
+        if self.auto_heal and not healed and (
+            self.replicas or self.manifest.origin is not None
+        ):
+            try:
+                how = heal_chunk(self.root, self.manifest, rec,
+                                 replicas=self.replicas)
+            except HealError as e:
+                reason = f"{reason}; heal failed ({e})"
+            else:
+                warnings.warn(
+                    f"store: chunk {idx} ({rec.digest[:16]}...) was "
+                    f"corrupt ({reason}) and healed in place from "
+                    f"{how} — the stream continues",
+                    RuntimeWarning, stacklevel=4,
+                )
+                self._verified.discard(idx)
+                return self._chunk_bytes(idx, _healed=True)
+        self._quarantine(idx, rec, reason)
+
     def _quarantine(self, idx: int, rec: ChunkRecord, reason: str):
         """Record a corrupt chunk and fail fast with the cursor named.
 
         The file is left in place (the operator may be able to recover
         it — e.g. re-copy from a replica; content addressing means a
         recovered chunk needs no manifest surgery), but its address is
-        appended to quarantine.json so post-mortem tooling sees every
-        incident even after the process dies."""
-        telemetry.count("store.verify_failures")
+        appended to quarantine.json (atomically and idempotently —
+        store/quarantine.py) so post-mortem tooling sees every incident
+        even after the process dies."""
         telemetry.count("store.quarantined")
-        qpath = os.path.join(self.root, QUARANTINE_NAME)
-        entry = {"chunk": idx, "digest": rec.digest,
-                 "file": rec.filename(), "start": rec.start,
-                 "stop": rec.stop, "reason": reason}
-        try:
-            existing = []
-            if os.path.exists(qpath):
-                with open(qpath) as f:
-                    existing = json.load(f)
-            if not any(e.get("digest") == rec.digest for e in existing):
-                existing.append(entry)
-                tmp = qpath + f".tmp.{os.getpid()}"
-                with open(tmp, "w") as f:
-                    json.dump(existing, f)
-                os.replace(tmp, qpath)
-        except (OSError, ValueError) as e:
-            warnings.warn(
-                f"store: could not record quarantined chunk in {qpath} "
-                f"({e}) — the corruption error below still stands",
-                RuntimeWarning, stacklevel=3,
-            )
+        qledger.record(self.root, {
+            "chunk": idx, "digest": rec.digest,
+            "file": rec.filename(), "start": rec.start,
+            "stop": rec.stop, "reason": reason,
+        })
         raise StoreCorruptError(
             f"store chunk {idx} ({rec.filename()}, variants "
             f"[{rec.start}, {rec.stop})) is corrupt: {reason} — the "
@@ -182,8 +211,10 @@ class StoreSource:
             rec.start,
         )
 
-    def _chunk_bytes(self, idx: int) -> np.ndarray:
-        """The chunk's packed bytes, mapped and (first touch) verified."""
+    def _chunk_bytes(self, idx: int, _healed: bool = False) -> np.ndarray:
+        """The chunk's packed bytes, mapped and (first touch) verified.
+        Damage on any check routes through :meth:`_damaged` — one heal
+        attempt, then quarantine + fail."""
         rec = self.manifest.chunks[idx]
         path = self._chunk_path(rec)
         # Chaos site BEFORE the mapping: an armed truncate corrupts the
@@ -197,8 +228,9 @@ class StoreSource:
                           shape=(self.n_samples, w_bytes))
         except ValueError as e:
             # Wrong file size for the catalog shape = truncation.
-            self._quarantine(idx, rec, f"wrong size for "
-                            f"({self.n_samples}, {w_bytes}) bytes ({e})")
+            return self._damaged(
+                idx, rec, f"wrong size for ({self.n_samples}, "
+                f"{w_bytes}) bytes ({e})", _healed)
         except FileNotFoundError:
             # A cataloged chunk that does not exist is damage (a lost
             # replica copy, a deleted quarantined file), not weather —
@@ -206,14 +238,16 @@ class StoreSource:
             # layer's whole reopen budget re-missing the same file and
             # end with no recovery guidance. Other OSErrors (EIO, a
             # flapping mount) stay retryable.
-            self._quarantine(idx, rec, "chunk file missing")
+            return self._damaged(idx, rec, "chunk file missing", _healed)
         if self.verify and idx not in self._verified:
             got = hashing.sha256_bytes(m)
             telemetry.count("store.chunks_verified")
             if got != rec.digest:
-                self._quarantine(
+                # Release the mapping before a heal rewrites the file.
+                del m
+                return self._damaged(
                     idx, rec, f"sha256 {got[:16]}... does not match the "
-                    "content address (bit rot or a torn write)")
+                    "content address (bit rot or a torn write)", _healed)
             self._verified.add(idx)
         return m
 

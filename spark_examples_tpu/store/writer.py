@@ -69,7 +69,7 @@ def _write_chunk(path: str, block: np.ndarray) -> tuple[str, int]:
 
 @telemetry.traced("store.compact", cat="store")
 def compact(path: str, source, chunk_variants: int = 16384,
-            workers: int = 1) -> StoreManifest:
+            workers: int = 1, origin: dict | None = None) -> StoreManifest:
     """Stream ``source`` into a content-addressed store at ``path``.
 
     ``chunk_variants`` is the catalog granularity: the unit of range
@@ -84,6 +84,13 @@ def compact(path: str, source, chunk_variants: int = 16384,
     ranges, exact-source block stripes), stage B packs + hashes + writes
     each chunk in a second bounded pool, both reassembled in order. The
     serial ``workers=1`` path below is the semantic reference.
+
+    ``origin`` (an IngestConfig-shaped dict — build one with
+    ``store.heal.origin_from_ingest``) is recorded in the manifest as
+    the store's self-healing recipe: a later corrupt chunk can be
+    re-compacted from the origin source in place and verified against
+    its content address (store/heal.py). None disables healing-from-
+    origin for this store (replica healing still works).
     """
     from spark_examples_tpu.ingest import bitpack
 
@@ -176,6 +183,7 @@ def compact(path: str, source, chunk_variants: int = 16384,
         sample_ids=list(source.sample_ids),
         has_positions=has_positions,
         positions_digest=positions_digest,
+        origin=origin,
     )
     manifest.save(path)  # the commit point
     return manifest

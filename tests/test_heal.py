@@ -1,0 +1,302 @@
+"""Store self-healing (store/heal.py) and the atomic quarantine ledger
+(store/quarantine.py): origin re-compaction, replica copy, the inline
+heal-on-read path, the `store heal` CLI verb, and concurrent-writer
+idempotence."""
+
+import json
+import os
+import shutil
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.core.config import IngestConfig
+from spark_examples_tpu.pipelines import runner
+from spark_examples_tpu.store import quarantine as qledger
+from spark_examples_tpu.store.heal import (
+    HealError,
+    build_origin_source,
+    heal,
+    heal_chunk,
+    origin_from_ingest,
+)
+from spark_examples_tpu.store.manifest import StoreCorruptError, StoreManifest
+from spark_examples_tpu.store.reader import open_store
+from spark_examples_tpu.store.writer import compact
+
+N, V, CHUNK = 8, 512, 128
+
+
+@pytest.fixture
+def origin_cfg():
+    return IngestConfig(source="synthetic", n_samples=N, n_variants=V,
+                        seed=3, block_variants=CHUNK)
+
+
+@pytest.fixture
+def healable_store(tmp_path, origin_cfg):
+    """A store compacted WITH its origin recorded (schema v2)."""
+    store = str(tmp_path / "store")
+    compact(store, runner.build_source(origin_cfg), chunk_variants=CHUNK,
+            origin=origin_from_ingest(origin_cfg, CHUNK))
+    return store
+
+
+def _clean(store):
+    return open_store(store).read_range(0, V).copy()
+
+
+def _truncate_chunk(store, idx):
+    m = StoreManifest.load(store)
+    path = os.path.join(store, m.chunks[idx].filename())
+    with open(path, "r+b") as f:
+        f.truncate(5)
+    return m.chunks[idx]
+
+
+# ----------------------------------------------------------------- origin
+
+
+def test_origin_roundtrip(origin_cfg):
+    rec = origin_from_ingest(origin_cfg, CHUNK)
+    assert rec["source"] == "synthetic" and rec["chunk_variants"] == CHUNK
+    src = build_origin_source(rec)
+    assert (src.n_samples, src.n_variants) == (N, V)
+
+
+def test_origin_records_absolute_path():
+    """A relative --path is absolutized in the origin record: heals
+    run from whatever cwd the LATER job has, not the compaction's."""
+    cfg = IngestConfig(source="packed", path="rel/cohort")
+    rec = origin_from_ingest(cfg, CHUNK)
+    assert os.path.isabs(rec["path"])
+    assert rec["path"].endswith(os.path.join("rel", "cohort"))
+
+
+def test_corrupt_chunk_heals_from_origin_in_stream(healable_store):
+    """The acceptance path: a chunk truncated on disk is re-compacted
+    from the origin span IN PLACE during the read, re-verified, and the
+    stream completes bit-identically — no quarantine, no failed run."""
+    want = _clean(healable_store)
+    _truncate_chunk(healable_store, 1)
+    before = telemetry.counter_value("store.healed")
+    with pytest.warns(RuntimeWarning, match="healed in place from origin"):
+        got = open_store(healable_store).read_range(0, V)
+    np.testing.assert_array_equal(got, want)
+    assert telemetry.counter_value("store.healed") == before + 1
+    # Ledger clean, chunk bytes verifiable again.
+    assert qledger.load(healable_store) == []
+    rec = StoreManifest.load(healable_store).chunks[1]
+    from spark_examples_tpu.core.hashing import sha256_file
+
+    assert sha256_file(
+        os.path.join(healable_store, rec.filename())) == rec.digest
+
+
+def test_injected_truncate_heals_under_fault_harness(healable_store):
+    """Same path driven by the chaos harness's store.read truncate —
+    exactly what the soak's heal rounds arm."""
+    want = _clean(healable_store)
+    with faults.armed(["store.read:truncate:after=2:max=1:keep=4"]):
+        with pytest.warns(RuntimeWarning, match="healed in place"):
+            got = open_store(healable_store).read_range(0, V)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_missing_chunk_heals_from_replica(healable_store, tmp_path):
+    """A deleted chunk file is restored by verified copy from a peer
+    replica directory (tried before origin re-compaction)."""
+    want = _clean(healable_store)
+    replica = str(tmp_path / "replica")
+    shutil.copytree(healable_store, replica)
+    rec = StoreManifest.load(healable_store).chunks[2]
+    os.remove(os.path.join(healable_store, rec.filename()))
+    with pytest.warns(RuntimeWarning, match="healed in place from replica"):
+        got = open_store(healable_store,
+                         replicas=(replica,)).read_range(0, V)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_store_replicas_threaded_through_config(healable_store, tmp_path):
+    """--store-replicas reaches the reader through IngestConfig →
+    build_source → open_store (replicas are tried BEFORE origin)."""
+    want = _clean(healable_store)
+    replica = str(tmp_path / "rep")
+    shutil.copytree(healable_store, replica)
+    _truncate_chunk(healable_store, 1)
+    cfg = IngestConfig(source="store", path=healable_store,
+                       block_variants=CHUNK, store_replicas=[replica])
+    src = runner.build_source(cfg)
+    with pytest.warns(RuntimeWarning, match="healed in place from replica"):
+        got = np.concatenate([b for b, _ in src.blocks(CHUNK)], axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_no_route_quarantines_as_before(tmp_path, origin_cfg):
+    """A store without origin or replicas keeps the PR-4 contract:
+    quarantine + StoreCorruptError naming the resume cursor."""
+    store = str(tmp_path / "plain")
+    compact(store, runner.build_source(origin_cfg), chunk_variants=CHUNK)
+    assert StoreManifest.load(store).origin is None
+    _truncate_chunk(store, 1)
+    with pytest.raises(StoreCorruptError, match="resume"):
+        open_store(store).read_range(0, V)
+    assert len(qledger.load(store)) == 1
+
+
+def test_changed_origin_refuses_wrong_bytes(healable_store, tmp_path):
+    """Healing must be verifiable: if the origin no longer reproduces
+    the chunk's content address (here: the manifest's recorded seed is
+    tampered), the heal REFUSES to install different bytes and the
+    chunk quarantines."""
+    manifest_path = os.path.join(healable_store, "manifest.json")
+    raw = json.load(open(manifest_path))
+    raw["origin"]["seed"] = 999  # a different cohort entirely
+    with open(manifest_path, "w") as f:
+        json.dump(raw, f)
+    _truncate_chunk(healable_store, 0)
+    with pytest.raises(StoreCorruptError, match="heal failed"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            open_store(healable_store).read_range(0, V)
+    assert len(qledger.load(healable_store)) == 1
+
+
+def test_heal_chunk_no_route_raises(healable_store):
+    m = StoreManifest.load(healable_store)
+    m.origin = None
+    with pytest.raises(HealError, match="no replica"):
+        heal_chunk(healable_store, m, m.chunks[0])
+
+
+# ------------------------------------------------------- offline heal verb
+
+
+def test_heal_verb_repairs_ledger_and_verify_all(healable_store):
+    want = _clean(healable_store)
+    # Quarantine one chunk the hard way (auto-heal off), corrupt a
+    # second SILENTLY (no ledger entry — only --verify-all finds it).
+    _truncate_chunk(healable_store, 1)
+    with pytest.raises(StoreCorruptError):
+        open_store(healable_store, auto_heal=False).read_range(0, V)
+    assert len(qledger.load(healable_store)) == 1
+    _truncate_chunk(healable_store, 3)
+
+    report = heal(healable_store, verify_all=True)
+    assert report["damaged"] == 2 and not report["failed"]
+    assert sorted(h["how"] for h in report["healed"]) == ["origin", "origin"]
+    assert qledger.load(healable_store) == []
+    np.testing.assert_array_equal(_clean(healable_store), want)
+
+
+def test_heal_verifies_bytes_before_trusting_ledger(healable_store):
+    """The ledger is advisory: a quarantined chunk whose file was
+    restored by hand (the recovery path the quarantine error names)
+    must verify clean and just clear its entry — not be re-compacted,
+    and never reported unhealable."""
+    rec = StoreManifest.load(healable_store).chunks[1]
+    path = os.path.join(healable_store, rec.filename())
+    good = open(path, "rb").read()
+    _truncate_chunk(healable_store, 1)
+    with pytest.raises(StoreCorruptError):
+        open_store(healable_store, auto_heal=False).read_range(0, V)
+    assert len(qledger.load(healable_store)) == 1
+    with open(path, "wb") as f:  # the operator restores the file
+        f.write(good)
+    report = heal(healable_store)
+    assert report["failed"] == [] and report["damaged"] == 0
+    assert [h["how"] for h in report["healed"]] == ["already-intact"]
+    assert qledger.load(healable_store) == []
+
+
+def test_heal_clears_stale_ledger_entries(healable_store):
+    """Entries whose digest no longer exists in the manifest (the
+    store was re-compacted since the incident) are cleared and counted
+    — a phantom chunk must not alarm forever."""
+    qledger.record(healable_store, {"digest": "gone" * 16, "chunk": 0})
+    report = heal(healable_store)
+    assert report["stale_cleared"] == 1 and report["damaged"] == 0
+    assert qledger.load(healable_store) == []
+
+
+def test_store_heal_cli(healable_store, capsys):
+    from spark_examples_tpu.cli.main import main
+
+    _truncate_chunk(healable_store, 2)
+    with pytest.raises(StoreCorruptError):
+        open_store(healable_store, auto_heal=False).read_range(0, V)
+    assert main(["store", "heal", "--path", healable_store]) == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert report["healed"] and not report["failed"]
+    np.testing.assert_array_equal(
+        _clean(healable_store),
+        open_store(healable_store).read_range(0, V))
+
+
+def test_store_heal_cli_reports_unhealable(tmp_path, origin_cfg, capsys):
+    store = str(tmp_path / "plain")
+    compact(store, runner.build_source(origin_cfg), chunk_variants=CHUNK)
+    _truncate_chunk(store, 0)
+    with pytest.raises(StoreCorruptError):
+        open_store(store).read_range(0, V)
+    from spark_examples_tpu.cli.main import main
+
+    assert main(["store", "heal", "--path", store]) == 1
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert report["failed"] and "no replica" in report["failed"][0]["error"]
+
+
+# ------------------------------------------------- quarantine ledger (S2)
+
+
+def test_quarantine_record_is_idempotent_and_atomic(tmp_path):
+    root = str(tmp_path)
+    entry = {"digest": "d1", "chunk": 0, "reason": "x"}
+    assert qledger.record(root, entry) is True
+    assert qledger.record(root, entry) is False  # same digest: no dup
+    assert qledger.record(root, {"digest": "d2"}) is True
+    assert {e["digest"] for e in qledger.load(root)} == {"d1", "d2"}
+    assert qledger.remove(root, "d1") is True
+    assert qledger.remove(root, "d1") is False
+    assert [e["digest"] for e in qledger.load(root)] == ["d2"]
+    assert qledger.remove(root, "d2") is True
+    # Empty ledger = no file (the healthy state).
+    assert not os.path.exists(os.path.join(root, "quarantine.json"))
+
+
+def test_quarantine_concurrent_writers_lose_nothing(tmp_path):
+    """The satellite contract: N readahead workers quarantining
+    concurrently — some on the SAME chunk — must produce exactly one
+    entry per digest with no lost updates and no torn file."""
+    root = str(tmp_path)
+    digests = [f"d{i % 4}" for i in range(32)]  # 8 writers per digest
+
+    def write(d):
+        qledger.record(root, {"digest": d, "reason": "race"})
+
+    threads = [threading.Thread(target=write, args=(d,)) for d in digests]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    entries = qledger.load(root)
+    assert sorted(e["digest"] for e in entries) == ["d0", "d1", "d2", "d3"]
+
+
+def test_v1_manifest_loads_without_origin(tmp_path, origin_cfg):
+    """Schema compatibility: a version-1 manifest (pre-origin) loads
+    with origin=None and the store reads normally."""
+    store = str(tmp_path / "v1")
+    compact(store, runner.build_source(origin_cfg), chunk_variants=CHUNK)
+    path = os.path.join(store, "manifest.json")
+    raw = json.load(open(path))
+    raw["schema_version"] = 1
+    del raw["origin"]
+    with open(path, "w") as f:
+        json.dump(raw, f)
+    m = StoreManifest.load(store)
+    assert m.schema_version == 1 and m.origin is None
+    assert open_store(store).read_range(0, V).shape == (N, V)

@@ -1,0 +1,222 @@
+"""Kill-resume bit-identity matrix: {streaming gram, store compaction,
+serve hot-reload} x 3 seeded kill points each, every run supervised
+(core/supervisor.py) so the kill -> restart -> resume cycle is the REAL
+production path, and every resumed output compared bit-for-bit against
+an uninterrupted run."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, supervisor
+from tests.conftest import random_genotypes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GRAM_KILL_POINTS = (1, 3, 5)     # ingest.block_read hit the kill lands on
+COMPACT_KILL_POINTS = (0, 1, 2)
+SERVE_KILL_POINTS = (0, 2, 4)    # serve.request hit
+
+
+def _env(**extra):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    env.update(extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def packed_store(tmp_path_factory):
+    """One 16 x 1024 packed cohort shared by every matrix surface."""
+    from spark_examples_tpu.ingest.packed import save_packed
+
+    rng = np.random.default_rng(1234)
+    g = np.abs(random_genotypes(rng, 16, 1024, missing_rate=0.1))
+    store = str(tmp_path_factory.mktemp("cohort") / "packed")
+    save_packed(store, g, bits=2)
+    return store, g
+
+
+# ------------------------------------------------------- streaming gram
+
+
+def _gram_cmd(store, out, ckpt):
+    return [sys.executable, "-m", "spark_examples_tpu", "similarity",
+            "--source", "packed", "--path", store,
+            "--block-variants", "128", "--metric", "ibs",
+            "--checkpoint-dir", ckpt, "--checkpoint-every-blocks", "2",
+            "--output-path", out]
+
+
+@pytest.fixture(scope="module")
+def gram_clean(packed_store, tmp_path_factory):
+    store, _g = packed_store
+    d = tmp_path_factory.mktemp("gram_clean")
+    out = str(d / "clean.tsv")
+    p = subprocess.run(_gram_cmd(store, out, str(d / "ck")),
+                       env=_env(), capture_output=True, text=True,
+                       timeout=240)
+    assert p.returncode == 0, p.stderr[-2000:]
+    with open(out, "rb") as f:
+        return f.read()
+
+
+@pytest.mark.parametrize("kill_after", GRAM_KILL_POINTS)
+def test_gram_kill_resume_bit_identical(packed_store, gram_clean,
+                                        tmp_path, kill_after):
+    """Supervised streaming-gram run killed at the Nth block read:
+    the supervisor restarts it, the checkpoint resumes it, and the
+    output bytes equal the uninterrupted run's."""
+    store, _g = packed_store
+    out = str(tmp_path / "sim.tsv")
+    env = _env(**{
+        faults.ENV_SPECS:
+            f"ingest.block_read:kill:after={kill_after}:max=1",
+    })
+    cmd = _gram_cmd(store, out, str(tmp_path / "ck")) + ["--supervise"]
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "supervisor: attempt 0: crash: exit code 113" in p.stderr
+    with open(out, "rb") as f:
+        assert f.read() == gram_clean
+
+
+# ------------------------------------------------------ store compaction
+
+
+def _ingest_cmd(src_store, out_store):
+    return [sys.executable, "-m", "spark_examples_tpu", "ingest",
+            "--source", "packed", "--path", src_store,
+            "--block-variants", "128", "--chunk-variants", "256",
+            "--ingest-workers", "2", "--output-path", out_store]
+
+
+@pytest.fixture(scope="module")
+def compact_clean(packed_store, tmp_path_factory):
+    store, _g = packed_store
+    out = str(tmp_path_factory.mktemp("compact_clean") / "store")
+    p = subprocess.run(_ingest_cmd(store, out), env=_env(),
+                       capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, p.stderr[-2000:]
+    with open(os.path.join(out, "manifest.json"), "rb") as f:
+        manifest = f.read()
+    chunks = sorted(os.listdir(os.path.join(out, "chunks")))
+    return manifest, chunks
+
+
+@pytest.mark.parametrize("kill_after", COMPACT_KILL_POINTS)
+def test_compact_kill_resume_byte_identical(packed_store, compact_clean,
+                                            tmp_path, kill_after):
+    """Supervised compaction killed mid-stream: the crashed attempt
+    leaves chunks but NO manifest (the commit point), the restart
+    re-compacts idempotently (content-addressed dedupe + wrong-size
+    healing), and manifest + chunk set are byte-identical to a clean
+    compaction."""
+    store, _g = packed_store
+    out = str(tmp_path / "store")
+    env = _env(**{
+        faults.ENV_SPECS:
+            f"ingest.block_read:kill:after={kill_after}:max=1",
+    })
+    cmd = _ingest_cmd(store, out) + ["--supervise"]
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "exit code 113" in p.stderr  # the kill really happened
+    want_manifest, want_chunks = compact_clean
+    with open(os.path.join(out, "manifest.json"), "rb") as f:
+        assert f.read() == want_manifest
+    assert sorted(os.listdir(os.path.join(out, "chunks"))) == want_chunks
+
+
+# ------------------------------------------------------ serve hot-reload
+
+
+_SERVE_SCRIPT = r"""
+import sys
+import numpy as np
+from spark_examples_tpu.core.virtual import force_virtual_cpu
+force_virtual_cpu(2)
+from spark_examples_tpu.ingest.packed import load_packed
+from spark_examples_tpu.serve import ProjectionEngine, ProjectionServer
+
+model3, model5, panel, out = sys.argv[1:5]
+engine = ProjectionEngine(model3, load_packed(panel),
+                          block_variants=128, max_batch=2)
+server = ProjectionServer(engine, cache_entries=0).start()
+rng = np.random.default_rng(5)
+queries = rng.integers(0, 3, size=(3, engine.n_variants)).astype(np.int8)
+before = [server.project(q, timeout=60) for q in queries]
+server.reload_model(model5)   # the hot-reload under test
+after = [server.project(q, timeout=60) for q in queries]
+assert server.drain(timeout=60)
+server.close()
+np.savez(out, before=np.concatenate(before), after=np.concatenate(after))
+"""
+
+
+@pytest.fixture(scope="module")
+def serve_models(packed_store, tmp_path_factory):
+    """Two models on the same panel (k=3 and k=5) fitted once, plus the
+    clean (uninterrupted) serve-reload-serve outputs."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+
+    store, _g = packed_store
+    d = tmp_path_factory.mktemp("serve_models")
+    models = {}
+    for k in (3, 5):
+        models[k] = str(d / f"m{k}.npz")
+        pcoa_job(JobConfig(
+            ingest=IngestConfig(source="packed", path=store,
+                                block_variants=128),
+            compute=ComputeConfig(metric="ibs", num_pc=k),
+            model_path=models[k],
+        ))
+    clean_out = str(d / "clean.npz")
+    p = subprocess.run(
+        [sys.executable, "-c", _SERVE_SCRIPT, models[3], models[5],
+         store, clean_out],
+        env=_env(), capture_output=True, text=True, timeout=240,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    return models, np.load(clean_out)
+
+
+@pytest.mark.parametrize("kill_after", SERVE_KILL_POINTS)
+def test_serve_hot_reload_kill_resume_bit_identical(
+        packed_store, serve_models, tmp_path, kill_after):
+    """The serving process killed at the Nth admitted request — before,
+    during, or after the hot-reload — then restarted by the supervisor:
+    the restarted server (same panel staging, same reload) produces
+    coordinates bit-identical to the uninterrupted run."""
+    store, _g = packed_store
+    models, clean = serve_models
+    out = str(tmp_path / "coords.npz")
+    env = _env(**{
+        faults.ENV_SPECS: f"serve.request:kill:after={kill_after}:max=1",
+    })
+    run = supervisor.supervise(
+        [sys.executable, "-c", _SERVE_SCRIPT, models[3], models[5],
+         store, out],
+        policy=supervisor.SupervisorPolicy(max_restarts=2,
+                                           startup_timeout_s=240.0),
+        env=env, heartbeat_path=str(tmp_path / "hb"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    assert run.ok, run.incidents
+    assert run.restarts == 1  # the kill really happened, once
+    assert "exit code 113" in run.incidents[0]
+    got = np.load(out)
+    np.testing.assert_array_equal(got["before"], clean["before"])
+    np.testing.assert_array_equal(got["after"], clean["after"])

@@ -290,7 +290,9 @@ def test_http_front(rng, tmp_path):
         np.testing.assert_array_equal(got, want)
         with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
             health = json.loads(r.read())
-        assert health["status"] == "serving"
+        assert health["status"] == "healthy"
+        assert health["panel"] == "staged"
+        assert health["worker_alive"] and health["worker_restarts"] == 0
         assert health["n_variants"] == 256
         with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
             stats = json.loads(r.read())
@@ -363,6 +365,181 @@ def test_engine_rejects_malformed_queries(rng, tmp_path):
                              block_variants=BV)
     finally:
         server.close()
+
+
+def test_worker_loop_error_recovers_without_dropping(rng, tmp_path,
+                                                     monkeypatch):
+    """Availability hardening: an unexpected failure in the worker LOOP
+    (outside the per-batch backstop) is caught by the supervision net —
+    the worker keeps running, admitted requests are answered, and
+    health degrades for the cooloff window."""
+    import time as _time
+
+    from spark_examples_tpu.serve import health as H
+
+    g_ref, model, job = _fit(tmp_path, rng, n=10, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine).start()
+    try:
+        assert server.health == "healthy"
+        real_collect = server._collect
+        blown = []
+
+        def exploding_collect():
+            if not blown:
+                blown.append(True)
+                raise RuntimeError("synthetic worker-loop failure")
+            return real_collect()
+
+        monkeypatch.setattr(server, "_collect", exploding_collect)
+        query = random_genotypes(rng, n=1, v=256)[0]
+        with pytest.warns(RuntimeWarning, match="worker recovered"):
+            got = server.project(query, timeout=60)
+        np.testing.assert_array_equal(
+            got, _offline(job, model, g_ref, query))
+        assert server._worker_restarts == 1
+        assert server.health == "degraded"
+        info = server.health_info()
+        assert info["worker_alive"] and info["worker_restarts"] == 1
+        # The cooloff expires -> healthy again (clock nudged, not slept).
+        server._last_recovery = _time.monotonic() - H.DEGRADED_COOLOFF_S - 1
+        assert server.health == "healthy"
+        assert server.drain(timeout=60)
+        assert server.health == "draining"
+    finally:
+        server.close()
+
+
+def test_in_flight_gauge_published_at_start(rng, tmp_path):
+    """The backlog gauge exists (at 0) from start(), BEFORE any
+    request: the supervisor's idle-server exemption reads it from the
+    heartbeat, so an unpublished gauge would get a pre-first-request
+    idle server stall-killed."""
+    g_ref, model, _job = _fit(tmp_path, rng, n=10, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine).start()
+    try:
+        gauges = telemetry.metrics_snapshot()["gauges"]
+        assert gauges["serve.in_flight"]["last"] == 0
+        from spark_examples_tpu.core import supervisor
+
+        assert supervisor.heartbeat_payload()["in_flight"] == 0
+    finally:
+        server.close()
+
+
+def test_dead_worker_thread_restarted_at_admission(rng, tmp_path):
+    """A worker thread that DIED (not just errored) is replaced at the
+    next submit without dropping anything already admitted."""
+    g_ref, model, job = _fit(tmp_path, rng, n=10, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    server = ProjectionServer(engine).start()
+    try:
+        # Simulate an untrappable death: stop the loop, let the thread
+        # exit, then re-arm the (still-open) server.
+        server._stop.set()
+        server._worker.join(timeout=10)
+        assert not server._worker.is_alive()
+        server._stop.clear()
+        query = random_genotypes(rng, n=1, v=256)[0]
+        with pytest.warns(RuntimeWarning, match="found dead at admission"):
+            got = server.project(query, timeout=60)
+        np.testing.assert_array_equal(
+            got, _offline(job, model, g_ref, query))
+        assert server._worker_restarts == 1
+        assert telemetry.counter_value("serve.worker_restarts") == 1
+    finally:
+        server.close()
+
+
+def test_store_breaker_trips_to_cached_panel_mode(rng, tmp_path):
+    """The store-read circuit breaker: repeated store failures during a
+    panel re-stage trip it open; the server keeps serving BIT-IDENTICAL
+    results from the cached panel (degraded), and a later successful
+    half-open probe closes it again (healthy)."""
+    from spark_examples_tpu.core import faults
+    from spark_examples_tpu.pipelines import runner as R
+    from spark_examples_tpu.core.config import IngestConfig
+    from spark_examples_tpu.serve import CircuitBreaker
+    from spark_examples_tpu.store.writer import compact
+
+    g_ref, model, job = _fit(tmp_path, rng, n=10, v=256)
+    store = str(tmp_path / "panel_store")
+    compact(store, ArraySource(g_ref), chunk_variants=64)
+    panel_cfg = IngestConfig(source="store", path=store,
+                             block_variants=BV, readahead_chunks=0,
+                             io_retries=0)
+    engine = ProjectionEngine(model, R.build_source(panel_cfg),
+                              block_variants=BV, max_batch=2)
+    engine.breaker = CircuitBreaker(trip_after=2, reset_s=0.05)
+    server = ProjectionServer(engine).start()
+    query = random_genotypes(rng, n=1, v=256)[0]
+    want = _offline(job, model, g_ref, query)
+    try:
+        np.testing.assert_array_equal(server.project(query, timeout=60),
+                                      want)
+        with faults.armed(["store.read:io_error:max=0"]):
+            with pytest.warns(RuntimeWarning, match="re-stage failed"):
+                assert server.restage_panel(
+                    R.build_source(panel_cfg)) is False
+            with pytest.warns(RuntimeWarning, match="re-stage failed"):
+                assert server.restage_panel(
+                    R.build_source(panel_cfg)) is False
+            # Tripped: open -> short-circuit, the store is NOT touched.
+            assert engine.breaker.state in ("open", "half-open")
+            assert engine.panel_mode == "cached-only"
+            assert server.health == "degraded"
+            assert server.health_info()["panel"] == "cached-only"
+            # Cached-panel-only mode still serves, bit-identically.
+            np.testing.assert_array_equal(
+                server.project(query, timeout=60), want)
+        # Store recovered: the half-open probe re-stages and closes.
+        import time as _time
+
+        _time.sleep(0.06)
+        assert server.restage_panel(R.build_source(panel_cfg)) is True
+        assert engine.breaker.state == "closed"
+        assert server.health == "healthy"
+        np.testing.assert_array_equal(server.project(query, timeout=60),
+                                      want)
+    finally:
+        server.close()
+
+
+def test_restage_refuses_panel_identity_change(rng, tmp_path):
+    """A re-stage streaming a different variant count must be refused
+    (fed to the breaker as a failure), never swapped under the model."""
+    g_ref, model, _job = _fit(tmp_path, rng, n=10, v=256)
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=BV, max_batch=2)
+    with pytest.warns(RuntimeWarning, match="re-stage failed"):
+        assert engine.restage(ArraySource(g_ref[:, :128])) is False
+
+
+def test_breaker_state_machine():
+    """CircuitBreaker unit semantics with an injected clock."""
+    from spark_examples_tpu.serve import CircuitBreaker
+
+    now = [0.0]
+    b = CircuitBreaker(trip_after=2, reset_s=10.0, clock=lambda: now[0])
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "closed"  # one failure is weather
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    assert telemetry.counter_value("serve.breaker_open") == 1
+    now[0] = 10.1  # reset window elapsed -> one probe allowed
+    assert b.state == "half-open"
+    assert b.allow() and not b.allow()  # single probe at a time
+    b.record_failure()  # failed probe re-opens the clock
+    assert b.state == "open" and not b.allow()
+    now[0] = 20.3
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.allow()
 
 
 def test_serve_cli_loadgen_mode(rng, tmp_path, capsys):

@@ -1,0 +1,249 @@
+"""Supervisor layer (core/supervisor.py): heartbeat writer, watchdog
+crash/hang/stall detection, restart semantics, and the fault site.
+
+The children here are tiny jax-free python scripts, so the whole suite
+runs in seconds — the jax-shaped end-to-end supervision story (kill +
+checkpoint resume, bit-identity) lives in tests/test_kill_matrix.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from spark_examples_tpu.core import faults, supervisor, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST = supervisor.SupervisorPolicy(
+    max_restarts=2, heartbeat_timeout_s=1.0, stall_timeout_s=1.0,
+    stall_blocks=0.0, startup_timeout_s=5.0, poll_s=0.02, grace_s=1.0,
+)
+
+
+def _env(**extra):
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        # Fast beats: the watchdog budgets in these tests are sub-second,
+        # and the default 0.5 s interval leaves too little scheduling
+        # margin on a loaded CI box.
+        **{supervisor.ENV_HEARTBEAT_INTERVAL: "0.1"},
+    )
+    env.update(extra)
+    return env
+
+
+def _run(script: str, policy=FAST, tmp_path=None, **kw):
+    hb = str(tmp_path / "hb.json") if tmp_path is not None else None
+    return supervisor.supervise(
+        [sys.executable, "-c", script], policy=policy,
+        heartbeat_path=hb, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, **kw)
+
+
+# --------------------------------------------------------------- child side
+
+
+def test_heartbeat_writer_and_progress_token(tmp_path):
+    telemetry.reset()
+    hb = str(tmp_path / "beat.json")
+    w = supervisor.HeartbeatWriter(hb, interval_s=0.05).start()
+    try:
+        payload = json.load(open(hb))
+        assert payload["pid"] == os.getpid()
+        p0 = payload["progress"]
+        telemetry.count("faults.fired")  # any instrumented work
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if json.load(open(hb))["progress"] > p0:
+                break
+            time.sleep(0.02)
+        assert json.load(open(hb))["progress"] > p0
+        assert telemetry.counter_value("supervisor.heartbeats") >= 1
+    finally:
+        w.stop()
+    telemetry.reset()
+
+
+def test_maybe_start_heartbeat_from_env(tmp_path):
+    assert supervisor.maybe_start_heartbeat({}) is None
+    hb = str(tmp_path / "env.json")
+    w = supervisor.maybe_start_heartbeat({supervisor.ENV_HEARTBEAT: hb})
+    try:
+        assert w is not None and os.path.exists(hb)
+    finally:
+        w.stop()
+
+
+def test_heartbeat_write_failure_is_tolerated(tmp_path):
+    """An injected io_error at the supervisor.heartbeat site fails one
+    write with a warning — the writer (and the job it reports on)
+    keeps running."""
+    hb = str(tmp_path / "faulty.json")
+    with faults.armed(["supervisor.heartbeat:io_error:after=0:max=1"]):
+        with pytest.warns(RuntimeWarning, match="heartbeat write"):
+            w = supervisor.HeartbeatWriter(hb, interval_s=0.02).start()
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline and not os.path.exists(hb):
+                time.sleep(0.02)
+            assert os.path.exists(hb)  # later beats landed
+        finally:
+            w.stop()
+
+
+# ------------------------------------------------------------- parent side
+
+
+def test_clean_child_passes_through(tmp_path):
+    run = _run("import sys; sys.exit(0)", tmp_path=tmp_path)
+    assert run.ok and run.restarts == 0 and run.incidents == []
+
+
+def test_crash_restarts_until_success(tmp_path):
+    """Child crashes on the first attempt (marker file tracks attempts),
+    succeeds on the second — the supervisor hides the crash."""
+    marker = tmp_path / "attempt"
+    script = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close(); sys.exit(17)\n"
+        "sys.exit(0)\n"
+    )
+    with pytest.warns(RuntimeWarning, match="child crash"):
+        run = _run(script, tmp_path=tmp_path)
+    assert run.ok and run.restarts == 1
+    assert "exit code 17" in run.incidents[0]
+
+
+def test_usage_error_exit_is_not_retried(tmp_path):
+    """Exit code 2 (argparse usage error) fails identically every
+    attempt — the supervisor must report it once, not burn the restart
+    budget re-printing it."""
+    run = _run("import sys; sys.exit(2)", tmp_path=tmp_path)
+    assert not run.ok and run.returncode == 2 and run.restarts == 0
+    assert "non-retryable" in run.incidents[-1]
+
+
+def test_restart_budget_exhausts_with_last_code(tmp_path):
+    with pytest.warns(RuntimeWarning):
+        run = _run("import sys; sys.exit(9)", tmp_path=tmp_path)
+    assert not run.ok and run.returncode == 9
+    assert run.restarts == FAST.max_restarts
+    assert "budget" in run.incidents[-1]
+
+
+def test_hang_without_heartbeat_is_killed(tmp_path):
+    """A child that never heartbeats and never exits is killed at the
+    startup budget and restarted; the restart completes."""
+    marker = tmp_path / "attempt"
+    script = (
+        "import os, sys, time\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close(); time.sleep(600)\n"
+        "sys.exit(0)\n"
+    )
+    policy = supervisor.SupervisorPolicy(
+        max_restarts=1, startup_timeout_s=1.0, poll_s=0.02, grace_s=0.5)
+    with pytest.warns(RuntimeWarning, match="child hang"):
+        run = _run(script, policy=policy, tmp_path=tmp_path)
+    assert run.ok and run.watchdog_kills == 1 and run.restarts == 1
+    assert "startup budget" in run.incidents[0]
+
+
+def test_stall_frozen_progress_is_killed(tmp_path):
+    """Heartbeats keep arriving but the progress token never moves:
+    the watchdog must call it a stall (naming the queue gauges) and
+    restart."""
+    marker = tmp_path / "attempt"
+    script = (
+        "import os, sys, time\n"
+        "from spark_examples_tpu.core import supervisor\n"
+        "w = supervisor.maybe_start_heartbeat()\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close(); time.sleep(600)\n"  # alive, no progress
+        "w.stop(); sys.exit(0)\n"
+    )
+    with pytest.warns(RuntimeWarning, match="child stall"):
+        run = _run(script, env=_env(), tmp_path=tmp_path)
+    assert run.ok and run.watchdog_kills == 1 and run.restarts == 1
+    assert "progress frozen" in run.incidents[0]
+    assert "prefetch_queue_depth" in run.incidents[0]  # gauge diagnosis
+
+
+def test_stalled_heartbeat_thread_is_a_hang(tmp_path):
+    """The supervisor.heartbeat fault site, end to end: a delay spec
+    freezes the child's heartbeat thread (the job could even be fine —
+    from outside they are indistinguishable), the watchdog kills at the
+    heartbeat budget, and the restarted child (faults stripped) runs
+    clean."""
+    script = (
+        "import sys, time\n"
+        "from spark_examples_tpu.core import supervisor\n"
+        "w = supervisor.maybe_start_heartbeat()\n"
+        "time.sleep(2.5)\n"
+        "sys.exit(0)\n"
+    )
+    env = _env(**{
+        faults.ENV_SPECS: "supervisor.heartbeat:delay:delay=600:max=1",
+    })
+    policy = supervisor.SupervisorPolicy(
+        max_restarts=1, heartbeat_timeout_s=0.8, stall_timeout_s=30.0,
+        startup_timeout_s=5.0, poll_s=0.02, grace_s=0.5)
+    with pytest.warns(RuntimeWarning, match="hang"):
+        run = _run(script, policy=policy, env=env, tmp_path=tmp_path)
+    assert run.ok and run.watchdog_kills == 1
+    # The restarted child ran with the fault env stripped (else the
+    # delay would re-freeze the first beat and the budget would burn).
+    assert run.restarts == 1
+
+
+def test_idle_server_is_not_stall_killed(tmp_path):
+    """A serving child reporting zero in-flight requests is IDLE, not
+    stalled: its progress token may freeze indefinitely between
+    requests and the watchdog must leave it alone (a batch job with
+    the same frozen token IS killed — test_stall_frozen_progress)."""
+    script = (
+        "import sys, time\n"
+        "from spark_examples_tpu.core import supervisor, telemetry\n"
+        "telemetry.gauge_set('serve.in_flight', 0)\n"
+        "w = supervisor.maybe_start_heartbeat()\n"
+        "time.sleep(2.5)\n"  # >> FAST.stall_timeout_s, token frozen
+        "w.stop(); sys.exit(0)\n"
+    )
+    run = _run(script, env=_env(), tmp_path=tmp_path)
+    assert run.ok and run.watchdog_kills == 0 and run.restarts == 0
+
+
+# ------------------------------------------------------------------ CLI glue
+
+
+def test_strip_supervise_flags():
+    argv = ["similarity", "--supervise", "--metric", "ibs",
+            "--supervise-max-restarts", "5",
+            "--supervise-stall-timeout=9.5", "--output-path", "o.tsv"]
+    assert supervisor.strip_supervise_flags(argv) == [
+        "similarity", "--metric", "ibs", "--output-path", "o.tsv"]
+
+
+def test_kill_exit_code_counts_as_crash(tmp_path):
+    """The fault harness's os._exit(113) is an ordinary crash to the
+    supervisor (restart + resume), distinguishable in incidents."""
+    marker = tmp_path / "attempt"
+    script = (
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close(); os._exit(113)\n"
+        "sys.exit(0)\n"
+    )
+    with pytest.warns(RuntimeWarning, match="exit code 113"):
+        run = _run(script, tmp_path=tmp_path)
+    assert run.ok and run.restarts == 1

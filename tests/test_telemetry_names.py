@@ -92,6 +92,26 @@ def test_every_fault_site_is_declared():
     assert not dead, f"declared fault sites never fired in code: {dead}"
 
 
+def test_every_fault_site_is_armed_by_a_test():
+    """Every site in faults.SITES must be ARMED by at least one test —
+    a spec string ``site:kind`` somewhere under tests/ (faults.armed or
+    an env-armed subprocess). A site that is fired in production code
+    but never armed in a test is a recovery path the chaos harness has
+    never actually reached; it rots exactly like untested code because
+    it IS untested code."""
+    text = "\n".join(
+        p.read_text() for p in (REPO / "tests").glob("*.py"))
+    kinds = "|".join(faults.KINDS)
+    unarmed = [
+        site for site in faults.SITES
+        if not re.search(rf"{re.escape(site)}:(?:{kinds})", text)
+    ]
+    assert not unarmed, (
+        "fault sites declared in faults.SITES but never armed by any "
+        "test (add a test injecting at them): " + ", ".join(unarmed)
+    )
+
+
 def test_registry_is_well_formed():
     assert telemetry.NAMES, "registry emptied"
     for name, entry in telemetry.NAMES.items():
@@ -155,6 +175,16 @@ def test_core_names_present():
         "store.readahead.errors",
         "store.readahead.wait_s",
         "store.readahead.in_flight",
+        # supervision / self-healing / serve availability (this PR's
+        # instrumentation contract)
+        "supervisor.restarts",
+        "supervisor.stalls",
+        "supervisor.heartbeats",
+        "store.healed",
+        "store.heal",
+        "serve.health",
+        "serve.worker_restarts",
+        "serve.breaker_open",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution

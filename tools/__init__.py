@@ -1,0 +1,3 @@
+"""Operational tooling that is not part of the library API (the chaos
+soak harness). Importable as ``tools.*`` from the repo root — bench.py
+and the test suite both run with the repo on ``sys.path``."""

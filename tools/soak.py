@@ -1,0 +1,500 @@
+"""Chaos soak: a seeded randomized fault schedule over every subsystem.
+
+Single-fault tests prove each recovery path once; the soak proves they
+*compose*: N iterations, each arming one randomized fault spec
+(site x kind x after/max/params drawn from a seeded RNG) against a real
+job — the streamed gram pipeline over a store-backed source (retry,
+readahead, heal, checkpoint sites) or the projection server (request
+faults) — and checking the invariants after every round:
+
+- **Bit-identity.** The round's result equals the clean baseline
+  exactly (integer accumulations: there is no tolerance to hide
+  behind). For serve ``io_error`` rounds, exactly the injected
+  requests fail — explicitly, with the injected error — and every
+  other response is bit-identical.
+- **No deadlock.** The round completes inside a watchdog budget
+  (supervised subprocess rounds inherit the real watchdog;
+  in-process rounds are wall-clock-checked).
+- **No leaks.** Every pool/worker thread the round started is gone
+  again afterwards (readahead pools, serve workers, heartbeats), and
+  the decode cache sits within its byte bound.
+- **Consistent heal bookkeeping.** A round that corrupted a chunk on
+  disk must leave the store healed: ``store.healed`` advanced and the
+  quarantine ledger empty (the soak's store records its origin, so
+  every corruption is repairable).
+
+Any violation emits ONE repro line —
+``SOAK-REPRO seed=<s> iter=<i> spec=<site:kind:...> job=<kind>`` —
+which re-runs that exact round deterministically.
+
+``include_kill`` adds supervised subprocess rounds: the same job run
+via the CLI under ``--supervise`` with an injected ``kill`` at a
+randomized block, restarting from checkpoints; the output file must
+equal the clean run's bytes.
+
+Entry points: ``run_soak`` (library), ``bench.py --chaos-soak``
+(25 fixed-seed iterations in the bench headline), and the tier-1
+``soak``-marked smoke in tests/test_soak.py (in-process scenarios
+only, seconds not minutes).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+import warnings
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.core.config import (
+    ComputeConfig,
+    IngestConfig,
+    JobConfig,
+)
+from spark_examples_tpu.pipelines import runner
+from spark_examples_tpu.store import quarantine as qledger
+from spark_examples_tpu.store.heal import origin_from_ingest
+from spark_examples_tpu.store.writer import compact
+
+# Thread-name prefixes the soak owns end to end: any of these still
+# alive after a round (and a GC + settle window) is a leak.
+_SUSPECT_THREADS = ("store-readahead", "projection-serve-worker",
+                    "supervisor-heartbeat")
+
+# The in-process schedule: (job, site, kind, param ranges). `after` is
+# drawn per-round from its range so the fault lands at a different hit
+# each time; `max` bounds fires under the job's retry budget so the
+# documented contract is full recovery.
+SCENARIOS: tuple = (
+    ("gram", "ingest.block_read", "io_error",
+     dict(after=(0, 6), max=(1, 2))),
+    ("gram", "ingest.block_read", "delay",
+     dict(after=(0, 6), max=(1, 3), delay=0.01)),
+    ("gram", "store.read", "io_error", dict(after=(0, 3), max=(1, 2))),
+    # On-disk corruption: the chunk is truncated against its content
+    # address and must be HEALED from the recorded origin, in place,
+    # with the stream completing bit-identically.
+    ("gram", "store.read", "truncate", dict(after=(0, 3), max=(1, 1),
+                                            keep=8)),
+    ("gram", "store.readahead.decode", "io_error",
+     dict(after=(0, 2), max=(1, 1))),
+    ("gram", "device.put", "delay", dict(after=(0, 6), max=(1, 2),
+                                         delay=0.01)),
+    ("gram", "multihost.consensus", "delay",
+     dict(after=(0, 2), max=(1, 2), delay=0.01)),
+    ("gram", "checkpoint.tile_write", "truncate",
+     dict(after=(0, 7), max=(1, 1), keep=8)),
+    ("serve", "serve.request", "io_error", dict(after=(0, 5), max=(1, 1))),
+    ("serve", "serve.request", "delay", dict(after=(0, 5), max=(1, 2),
+                                             delay=0.02)),
+)
+
+KILL_SCENARIOS: tuple = (
+    ("cli", "ingest.block_read", "kill", dict(after=(2, 6), max=(1, 1))),
+    ("cli", "store.read", "kill", dict(after=(1, 3), max=(1, 1))),
+)
+
+
+@dataclass
+class SoakConfig:
+    workdir: str
+    iterations: int = 25
+    seed: int = 0
+    include_kill: bool = True
+    n_samples: int = 16
+    n_variants: int = 1024
+    chunk_variants: int = 256
+    block_variants: int = 128
+    round_budget_s: float = 60.0  # in-process deadlock watchdog
+    kill_budget_s: float = 300.0  # supervised subprocess rounds
+
+
+@dataclass
+class SoakReport:
+    iterations: int = 0
+    rounds: list = field(default_factory=list)
+    violations: list = field(default_factory=list)
+    healed: int = 0
+    retries: int = 0
+    restarts: int = 0
+    faults_fired: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict:
+        return {
+            "iterations": self.iterations,
+            "ok": self.ok,
+            "violations": self.violations,
+            "healed": self.healed,
+            "retries": self.retries,
+            "restarts": self.restarts,
+            "faults_fired": self.faults_fired,
+            "rounds": self.rounds,
+        }
+
+
+def _spec_str(site: str, kind: str, rng: random.Random,
+              params: dict) -> str:
+    """One randomized spec drawn from the scenario's ranges."""
+    after = rng.randint(*params["after"])
+    max_fires = rng.randint(*params["max"])
+    spec = f"{site}:{kind}:after={after}:max={max_fires}"
+    if "delay" in params:
+        spec += f":delay={params['delay']}"
+    if "keep" in params:
+        spec += f":keep={params['keep']}"
+    return spec
+
+
+def _suspect_counts() -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for t in threading.enumerate():
+        if not t.is_alive():
+            continue
+        for prefix in _SUSPECT_THREADS:
+            if t.name.startswith(prefix):
+                counts[prefix] = counts.get(prefix, 0) + 1
+    return counts
+
+
+def _leaked_threads(baseline: dict[str, int],
+                    settle_s: float = 5.0) -> list[str]:
+    """Suspect-thread prefixes whose live count exceeds the fixture
+    baseline after a settle window (pool threads wind down
+    asynchronously after their executor is released — poll, don't
+    snapshot). The baseline covers long-lived fixture plumbing (the
+    serve engine's panel source); a round must not ADD to it."""
+    deadline = time.monotonic() + settle_s
+    while True:
+        gc.collect()
+        over = [f"{k} x{v} (baseline {baseline.get(k, 0)})"
+                for k, v in _suspect_counts().items()
+                if v > baseline.get(k, 0)]
+        if not over or time.monotonic() > deadline:
+            return over
+        time.sleep(0.05)
+
+
+class _Fixture:
+    """Everything the rounds share: the origin-recorded store, the
+    clean baselines, and (for serve rounds) a warmed engine."""
+
+    def __init__(self, cfg: SoakConfig):
+        self.cfg = cfg
+        self.store_dir = os.path.join(cfg.workdir, "store")
+        self.ingest_cfg = IngestConfig(
+            source="synthetic", n_samples=cfg.n_samples,
+            n_variants=cfg.n_variants, seed=7,
+            block_variants=cfg.block_variants,
+        )
+        src = runner.build_source(self.ingest_cfg)
+        compact(self.store_dir, src, chunk_variants=cfg.chunk_variants,
+                origin=origin_from_ingest(self.ingest_cfg,
+                                          cfg.chunk_variants))
+        # Clean gram baseline over the store transport (the exact job
+        # the rounds run, no faults armed).
+        faults.disarm()
+        self.baseline_sim = self._gram_job(None).similarity
+        # Serve fixture: model fit over the same panel + warmed engine.
+        from spark_examples_tpu.pipelines.jobs import pcoa_job
+        from spark_examples_tpu.serve import ProjectionEngine
+
+        self.model_path = os.path.join(cfg.workdir, "model.npz")
+        fit_job = JobConfig(
+            ingest=IngestConfig(block_variants=cfg.block_variants),
+            compute=ComputeConfig(metric="ibs", num_pc=3),
+            model_path=self.model_path,
+        )
+        panel = runner.build_source(
+            IngestConfig(source="store", path=self.store_dir,
+                         block_variants=cfg.block_variants))
+        pcoa_job(fit_job, source=panel)
+        self._close_source(panel)
+        # Panel staged without readahead: the engine keeps its source
+        # (for restage), and a fixture-lifetime pool would sit in every
+        # round's thread accounting.
+        self.engine = ProjectionEngine(
+            self.model_path,
+            runner.build_source(
+                IngestConfig(source="store", path=self.store_dir,
+                             block_variants=cfg.block_variants,
+                             readahead_chunks=0)),
+            block_variants=cfg.block_variants, max_batch=4)
+        self.thread_baseline = _suspect_counts()
+        pool_rng = np.random.default_rng(11)
+        self.query_pool = pool_rng.integers(
+            0, 3, size=(6, cfg.n_variants)).astype(np.int8)
+        self.baseline_coords = [
+            self.engine.project_batch(q[None, :])
+            for q in self.query_pool
+        ]
+
+    @staticmethod
+    def _close_source(src) -> None:
+        for obj in (src, getattr(src, "inner", None)):
+            close = getattr(obj, "close", None)
+            if close is not None:
+                close()
+
+    def _gram_job(self, ckpt_dir: str | None):
+        job = JobConfig(
+            ingest=IngestConfig(
+                source="store", path=self.store_dir,
+                block_variants=self.cfg.block_variants,
+                io_retries=3, io_retry_backoff_s=0.001,
+                readahead_chunks=2, store_cache_mb=4,
+            ),
+            compute=ComputeConfig(
+                metric="ibs", checkpoint_dir=ckpt_dir,
+                checkpoint_every_blocks=2 if ckpt_dir else 0,
+            ),
+        )
+        src = runner.build_source(job.ingest)
+        try:
+            return runner.run_similarity(job, source=src)
+        finally:
+            self._close_source(src)
+
+    def store_consistent(self) -> str | None:
+        """Post-round store invariant: quarantine ledger empty and
+        every chunk file byte-verifiable. A reason string on violation."""
+        entries = qledger.load(self.store_dir)
+        if entries:
+            return (f"quarantine ledger not empty after the round "
+                    f"({len(entries)} entries — heal should have "
+                    "cleared them)")
+        from spark_examples_tpu.core import hashing
+        from spark_examples_tpu.store.manifest import StoreManifest
+
+        manifest = StoreManifest.load(self.store_dir)
+        for rec in manifest.chunks:
+            path = os.path.join(self.store_dir, rec.filename())
+            try:
+                if hashing.sha256_file(path) != rec.digest:
+                    return f"chunk {rec.digest[:16]}... corrupt on disk"
+            except OSError as e:
+                return f"chunk {rec.digest[:16]}... unreadable ({e})"
+        return None
+
+
+def _run_gram_round(fx: _Fixture, i: int, spec: str,
+                    round_seed: int) -> list[str]:
+    """One in-process gram round under `spec`; returns violations."""
+    problems: list[str] = []
+    ckpt = os.path.join(fx.cfg.workdir, f"ck{i}")
+    with faults.armed([spec], seed=round_seed):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            res = fx._gram_job(ckpt)
+    if not np.array_equal(res.similarity, fx.baseline_sim):
+        problems.append("gram result differs from clean baseline")
+    return problems
+
+
+def _run_serve_round(fx: _Fixture, spec: str,
+                     round_seed: int) -> list[str]:
+    """One in-process serve round: sequential queries through a fresh
+    server over the shared engine. Injected io_errors must fail exactly
+    their own request with the injected error; everything else must be
+    bit-identical; the drain must be clean."""
+    from spark_examples_tpu.serve import ProjectionServer
+
+    problems: list[str] = []
+    server = ProjectionServer(fx.engine, cache_entries=0,
+                              max_linger_s=0.001).start()
+    injected = 0
+    try:
+        with faults.armed([spec], seed=round_seed) as inj:
+            for qi, q in enumerate(fx.query_pool):
+                try:
+                    got = server.project(q, timeout=30.0)
+                except faults.InjectedFault:
+                    injected += 1
+                    continue
+                if not np.array_equal(got, fx.baseline_coords[qi]):
+                    problems.append(
+                        f"served coords for query {qi} differ from "
+                        "baseline")
+            fired = inj.fire_count("serve.request")
+        if injected != (fired if "io_error" in spec else 0):
+            problems.append(
+                f"{injected} requests failed with the injected error "
+                f"but {fired} io_error fault(s) fired")
+        if not server.drain(timeout=30.0):
+            problems.append("serve drain was not clean")
+    finally:
+        server.close()
+    return problems
+
+
+def _run_kill_round(fx: _Fixture, i: int, spec: str, round_seed: int,
+                    baseline_tsv: bytes) -> tuple[list[str], int]:
+    """One supervised subprocess round: the CLI job with an injected
+    kill, restarted by --supervise, output bytes vs the clean run.
+    Returns (violations, supervised restarts observed)."""
+    cfg = fx.cfg
+    out = os.path.join(cfg.workdir, f"kill{i}.tsv")
+    ckpt = os.path.join(cfg.workdir, f"killck{i}")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        **{faults.ENV_SPECS: spec,
+           faults.ENV_SEED: str(round_seed)},
+    )
+    cmd = _cli_gram_cmd(fx, out, ckpt) + ["--supervise"]
+    try:
+        p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=cfg.kill_budget_s)
+    except subprocess.TimeoutExpired:
+        return [f"supervised round exceeded the {cfg.kill_budget_s:.0f}s "
+                "watchdog budget (deadlock?)"], 0
+    restarts = p.stderr.count("supervisor: attempt")
+    if p.returncode != 0:
+        return [f"supervised run exited {p.returncode}: "
+                f"{p.stderr[-500:]}"], restarts
+    with open(out, "rb") as f:
+        got = f.read()
+    if got != baseline_tsv:
+        return ["supervised kill-resume output differs from the clean "
+                "run's bytes"], restarts
+    return [], restarts
+
+
+def _cli_gram_cmd(fx: _Fixture, out: str, ckpt: str) -> list[str]:
+    cfg = fx.cfg
+    return [
+        sys.executable, "-m", "spark_examples_tpu", "similarity",
+        "--source", f"store:{fx.store_dir}",
+        "--block-variants", str(cfg.block_variants),
+        "--metric", "ibs", "--io-retries", "3",
+        "--checkpoint-dir", ckpt, "--checkpoint-every-blocks", "2",
+        "--output-path", out,
+    ]
+
+
+def run_soak(cfg: SoakConfig) -> SoakReport:
+    """The harness. Deterministic for a given (SoakConfig.seed,
+    iterations, include_kill): the schedule, every spec's parameters,
+    and every injector seed derive from one ``random.Random``."""
+    os.makedirs(cfg.workdir, exist_ok=True)
+    rng = random.Random(cfg.seed)
+    report = SoakReport()
+    fx = _Fixture(cfg)
+
+    # Schedule: a seeded shuffle of the scenario table, repeated to
+    # `iterations` — randomized order/params with guaranteed site
+    # coverage once iterations >= the table size.
+    table = list(SCENARIOS) + (list(KILL_SCENARIOS) if cfg.include_kill
+                               else [])
+    schedule = []
+    while len(schedule) < cfg.iterations:
+        chunk = list(table)
+        rng.shuffle(chunk)
+        schedule.extend(chunk)
+    schedule = schedule[:cfg.iterations]
+
+    baseline_tsv = None
+    if cfg.include_kill and any(j == "cli" for j, *_ in schedule):
+        out = os.path.join(cfg.workdir, "clean.tsv")
+        p = subprocess.run(
+            _cli_gram_cmd(fx, out, os.path.join(cfg.workdir, "cleanck")),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=cfg.kill_budget_s,
+        )
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"clean CLI baseline failed: {p.stderr[-500:]}")
+        with open(out, "rb") as f:
+            baseline_tsv = f.read()
+
+    healed0 = telemetry.counter_value("store.healed")
+    retries0 = telemetry.counter_value("ingest.retries")
+    fired0 = telemetry.counter_value("faults.fired")
+
+    for i, (jobkind, site, kind, params) in enumerate(schedule):
+        round_seed = rng.randint(0, 2**31 - 1)
+        spec = _spec_str(site, kind, rng, params)
+        t0 = time.monotonic()
+        try:
+            if jobkind == "gram":
+                problems = _run_gram_round(fx, i, spec, round_seed)
+            elif jobkind == "serve":
+                problems = _run_serve_round(fx, spec, round_seed)
+            else:
+                problems, restarts = _run_kill_round(
+                    fx, i, spec, round_seed, baseline_tsv)
+                report.restarts += restarts
+        except BaseException as e:
+            problems = [f"round raised {e!r}"]
+        dt = time.monotonic() - t0
+        if jobkind != "cli" and dt > cfg.round_budget_s:
+            problems.append(
+                f"round took {dt:.1f}s (> {cfg.round_budget_s:.0f}s "
+                "budget — stall/deadlock)")
+        leaks = _leaked_threads(fx.thread_baseline)
+        if leaks:
+            problems.append(f"leaked threads: {leaks}")
+        reason = fx.store_consistent()
+        if reason:
+            problems.append(f"store bookkeeping: {reason}")
+        report.rounds.append({
+            "iter": i, "job": jobkind, "spec": spec,
+            "seed": round_seed, "s": round(dt, 2),
+            "ok": not problems,
+        })
+        for prob in problems:
+            report.violations.append(
+                f"SOAK-REPRO seed={cfg.seed} iter={i} spec={spec!r} "
+                f"job={jobkind}: {prob}")
+        report.iterations += 1
+        if problems:
+            break  # first violation stops the soak: the repro line is
+            # the deliverable, and later rounds run on a possibly
+            # damaged fixture
+    report.healed = int(telemetry.counter_value("store.healed") - healed0)
+    report.retries = int(telemetry.counter_value("ingest.retries")
+                         - retries0)
+    report.faults_fired = int(telemetry.counter_value("faults.fired")
+                              - fired0)
+    return report
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin CLI
+    import argparse
+    import tempfile
+
+    ap = argparse.ArgumentParser(description="chaos soak harness")
+    ap.add_argument("--iterations", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default=None,
+                    help="fixture dir (default: a fresh tmp dir, "
+                    "removed on a clean soak, kept on violation so the "
+                    "SOAK-REPRO line has its fixture)")
+    ap.add_argument("--no-kill", action="store_true")
+    args = ap.parse_args(argv)
+    own_workdir = args.workdir is None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="soak-")
+    report = run_soak(SoakConfig(
+        workdir=workdir, iterations=args.iterations, seed=args.seed,
+        include_kill=not args.no_kill))
+    print(json.dumps(report.to_json(), indent=1))
+    if report.ok and own_workdir:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())
