@@ -524,6 +524,98 @@ def bench_braycurtis() -> dict:
     return out
 
 
+def bench_sketch() -> dict:
+    """The streaming sketch solver (spark_examples_tpu/solvers) at
+    config-3 scale — N = 10k, the N where the exact route is already
+    only extrapolated (``exact_est_full_s``):
+
+    - ``sketch_s``: end-to-end wall-clock of the ladder's production
+      recommendation (corrected rung, rank 96, 1 + 4 streamed passes)
+      on a 10k x 64k GRM PCoA — feed included, like every streamed
+      number; ``sketch_1pass_s`` is the single-pass rung.
+    - accuracy vs the EXACT dense route at the N = 2500 comparison
+      scale (where dense eigh is measurable): full top-k max relerr for
+      both rungs plus the structure/bulk split of BASELINE.md's
+      "Randomized-solver accuracy" convention.
+    - the memory story: solver state actually held vs the N x N
+      accumulator bytes the dense route would have allocated (telemetry
+      ``solver.state_bytes`` / ``solver.nxn_bytes_avoided``).
+
+    The 10k coordinates must recover the planted ancestry (the same
+    fast-wrong-answer guard as every other timed path).
+    """
+    from spark_examples_tpu.core import telemetry
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest.synthetic import SyntheticSource
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+
+    N_SK, V_SK, N_CMP = 10_000, 65_536, 2500
+    RANK, ITERS, SEED = 96, 4, 11
+
+    def job(n, solver):
+        return JobConfig(
+            ingest=IngestConfig(source="synthetic", n_samples=n,
+                                n_variants=V_SK, block_variants=BLOCK,
+                                seed=SEED),
+            compute=ComputeConfig(metric="grm", num_pc=K, solver=solver,
+                                  sketch_rank=RANK, sketch_iters=ITERS),
+        )
+
+    out: dict = {"n": N_SK, "n_variants": V_SK, "rank": RANK,
+                 "iters": ITERS, "compare_n": N_CMP}
+
+    # Accuracy at the comparison scale.
+    t0 = time.perf_counter()
+    exact = pcoa_job(job(N_CMP, "exact"))
+    out["exact_2500_s"] = round(time.perf_counter() - t0, 3)
+    ev = np.asarray(exact.eigenvalues, np.float64)
+    for rung, key in (("sketch", "relerr_1pass_vs_exact_2500"),
+                      ("corrected", "relerr_vs_exact_2500")):
+        t0 = time.perf_counter()
+        got = pcoa_job(job(N_CMP, rung))
+        out[f"{rung}_2500_s"] = round(time.perf_counter() - t0, 3)
+        rel = (np.abs(np.asarray(got.eigenvalues, np.float64) - ev)
+               / np.maximum(np.abs(ev), 1e-30))
+        out[key] = round(float(rel.max()), 4)
+        out[f"{rung}_accuracy_2500"] = _accuracy_split(ev, got.eigenvalues)
+        log(f"sketch bench {rung}@2500: max relerr {rel.max():.4f} "
+            f"(structure {out[f'{rung}_accuracy_2500']['relerr_structure']:.2e})")
+
+    # The 10k runs the headline times — the scale the subsystem exists
+    # for (a grm accumulator alone would be 400 MB of N x N here; at
+    # the 100k north star it would be 40 GB and simply not exist).
+    t0 = time.perf_counter()
+    big = pcoa_job(job(N_SK, "corrected"))
+    out["sketch_s"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    pcoa_job(job(N_SK, "sketch"))
+    out["sketch_1pass_s"] = round(time.perf_counter() - t0, 3)
+    gauges = telemetry.metrics_snapshot()["gauges"]
+    out["solver_state_mb"] = round(
+        gauges["solver.state_bytes"]["last"] / 1e6, 2)
+    out["nxn_avoided_mb"] = round(
+        gauges["solver.nxn_bytes_avoided"]["last"] / 1e6, 1)
+
+    # Planted-ancestry recovery on the 10k coordinates (local twin of
+    # check_structure, which is bound to the 2504-sample SYN cohort).
+    pops = SyntheticSource(n_samples=N_SK, n_variants=V_SK,
+                           seed=SEED).populations
+    c = np.asarray(big.coords)[:, :4]
+    cents = np.stack([c[pops == p].mean(0) for p in range(5)])
+    within = np.mean([np.linalg.norm(c[i] - cents[pops[i]])
+                      for i in range(len(c))])
+    between = np.mean([np.linalg.norm(cents[a] - cents[b])
+                       for a in range(5) for b in range(a + 1, 5)])
+    out["structure_sep"] = round(float(between / within), 2)
+    log(f"sketch bench 10k: corrected {out['sketch_s']}s, 1-pass "
+        f"{out['sketch_1pass_s']}s, state {out['solver_state_mb']} MB vs "
+        f"{out['nxn_avoided_mb']} MB N x N avoided, separation "
+        f"{out['structure_sep']}x")
+    return out
+
+
 def bench_tile_rate() -> dict:
     """Config 4: per-chip gram rate at the 76k tile2d workload.
 
@@ -1210,6 +1302,7 @@ def main() -> None:
         ("config4", bench_tile_rate, ()),
         ("config4_solve", bench_tile_solve, ()),
         ("config5", bench_streaming, (store,)),
+        ("sketch", bench_sketch, ()),
     ):
         try:
             configs[name] = fn(*args)
@@ -1301,6 +1394,20 @@ def main() -> None:
         # p95 (0 in single-process runs).
         "telemetry": streamed["telemetry"],
     }
+    if "sketch" in configs and "error" not in configs["sketch"]:
+        sk = configs["sketch"]
+        # The sketch-solver headline: 10k end-to-end time of the
+        # corrected (production) rung, its relerr vs the exact dense
+        # route at the 2500 comparison scale, and peak solver memory
+        # (state actually held; the avoided N x N rides in configs).
+        headline["sketch_s"] = sk["sketch_s"]
+        headline["sketch_relerr_vs_exact_2500"] = sk[
+            "relerr_vs_exact_2500"]
+        headline["sketch_peak_mb"] = sk["solver_state_mb"]
+        headline["sketch_ok"] = bool(
+            sk["relerr_vs_exact_2500"] <= 0.1
+            and sk["structure_sep"] > 3.0
+        )
     if "chaos" in configs:
         headline["chaos_ok"] = configs["chaos"].get(
             "coords_bit_identical", False

@@ -180,6 +180,31 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    default=config.EIGH_OVERSAMPLE_DEFAULT,
                    help="randomized solver subspace oversample (k+p "
                    "probe columns)")
+    c.add_argument("--solver", default="exact",
+                   choices=list(config.SOLVER_LADDER),
+                   help="pcoa/pca eigensolve accuracy ladder: 'exact' "
+                   "materializes the N x N Gram (today's route); "
+                   "'sketch' folds a low-rank range sketch into (N, "
+                   "rank) state during the single variant pass and "
+                   "solves from the Nystrom core — no N x N anywhere, "
+                   "the route for cohorts past single-chip HBM; "
+                   "'corrected' adds --sketch-iters extra streamed "
+                   "power-iteration passes before a Rayleigh solve "
+                   "(see README 'Solvers & the accuracy ladder')")
+    c.add_argument("--sketch-rank", type=int,
+                   default=config.SKETCH_RANK_DEFAULT,
+                   help="sketch probe columns (>= --num-pc; clamped to "
+                   "N): the r of the O(N*r) solver state; components "
+                   "+ ~32-54 oversample is the usual shape")
+    c.add_argument("--sketch-iters", type=int,
+                   default=config.SKETCH_ITERS_DEFAULT,
+                   help="extra streamed passes of the corrected rung "
+                   "(each one full pass over the cohort; error "
+                   "contracts ~(lambda_{r+1}/lambda_k)^2 per pass)")
+    c.add_argument("--sketch-seed", type=int, default=0,
+                   help="probe RNG seed — a resumed/supervised job "
+                   "must keep it (the checkpoint records it and "
+                   "rejects a mismatch)")
     c.add_argument("--braycurtis-method", default="auto",
                    choices=["auto", "exact", "matmul", "pallas"],
                    help="braycurtis lowering: auto (pallas on an "
@@ -266,6 +291,10 @@ def _job_from_args(args) -> JobConfig:
             eigh_mode=args.eigh_mode,
             eigh_iters=args.eigh_iters,
             eigh_oversample=args.eigh_oversample,
+            solver=args.solver,
+            sketch_rank=args.sketch_rank,
+            sketch_iters=args.sketch_iters,
+            sketch_seed=args.sketch_seed,
             braycurtis_method=args.braycurtis_method,
             braycurtis_levels=args.braycurtis_levels,
             grm_precise=args.grm_precise,
@@ -494,8 +523,21 @@ def main(argv: list[str] | None = None) -> int:
             f"--metric {args.metric} is not accepted (use the similarity "
             "or pcoa subcommands for other metrics)"
         )
+    if (getattr(args, "solver", "exact") != "exact"
+            and args.command not in ("pcoa", "pca")):
+        parser.error(
+            f"--solver {args.solver} applies to the pcoa/pca eigensolve; "
+            f"'{args.command}' does not solve an eigenproblem (and "
+            "similarity's OUTPUT is the N x N matrix the sketch exists "
+            "to avoid)"
+        )
 
-    job = _job_from_args(args)
+    try:
+        job = _job_from_args(args)
+    except ValueError as e:
+        # Config-time knob validation (core/config.py) — surface it as
+        # the usage error it is, flag names intact, not a traceback.
+        parser.error(str(e))
 
     # Imports deferred so --help stays instant (no jax/TPU init).
     import os

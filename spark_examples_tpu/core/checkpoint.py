@@ -113,6 +113,7 @@ def save(
     sample_ids: list[str],
     stream_stats: dict | None = None,
     plan=None,
+    extra: dict | None = None,
 ) -> None:
     """Atomically persist accumulators + resume cursor.
 
@@ -134,6 +135,12 @@ def save(
     rotated and load() would find no manifest there). ``next_variant``
     is this process's LOCAL cursor into its own ingest partition,
     recorded per process.
+
+    ``extra``: caller-defined JSON-serializable compatibility record
+    (the sketch solver stores its rung/rank/seed/pass here); ``load``
+    rejects a checkpoint whose extra does not equal the job's — resuming
+    a sketch accumulation under a different probe seed or rank would
+    silently mix two different random subspaces.
     """
     proc = jax.process_index() if jax.process_count() > 1 else 0
     is_primary = proc == 0
@@ -227,6 +234,7 @@ def save(
         "mode": plan.mode if plan is not None else None,
         "process_count": jax.process_count(),
         "stream_stats": dict(stream_stats or {}),
+        "extra": dict(extra) if extra else None,
     }
     primary_error: Exception | None = None
     if is_primary:
@@ -541,8 +549,16 @@ def _promote_fallback(path: str, found):
 
 
 def load(path: str, metric: str, sample_ids: list[str],
-         block_variants: int | None = None, plan=None):
+         block_variants: int | None = None, plan=None,
+         leaves: list[str] | None = None, expect_extra: dict | None = None):
     """Load (acc, next_variant, stream_stats) or None when absent.
+
+    ``leaves``: expected accumulator leaf names when the checkpoint is
+    NOT a gram accumulation (the sketch solver's state) — without it the
+    expectation derives from the metric's gram pieces as before.
+    ``expect_extra``: required value of the manifest's ``extra`` record
+    (see :func:`save`); a mismatch is rejected like any other
+    incompatibility, never silently mixed in.
 
     Every file is checksum-verified BEFORE any leaf is placed on a
     device; a truncated/corrupt generation falls back to ``.old`` (with
@@ -590,11 +606,25 @@ def load(path: str, metric: str, sample_ids: list[str],
                 f"checkpoint at {path} was built for a different cohort "
                 f"({manifest['n_samples']} samples)"
             )
-        from spark_examples_tpu.ops import gram
+        if expect_extra is not None:
+            got = manifest.get("extra") or {}
+            if got != dict(expect_extra):
+                raise ValueError(
+                    f"checkpoint at {path} was written under solver/"
+                    f"sketch settings {got} but this job runs "
+                    f"{dict(expect_extra)} — a resume must keep the same "
+                    "probe seed/rank/rung (delete the checkpoint "
+                    "directory to deliberately restart)"
+                )
+        if leaves is not None:
+            expected = sorted(leaves)
+        else:
+            from spark_examples_tpu.ops import gram
 
-        expected = sorted(
-            ("zz", "nvar") if metric == "grm" else gram.PIECES_FOR_METRIC[metric]
-        )
+            expected = sorted(
+                ("zz", "nvar") if metric == "grm"
+                else gram.PIECES_FOR_METRIC[metric]
+            )
         if manifest["leaves"] != expected:
             raise ValueError(
                 f"checkpoint at {path} holds accumulator leaves "

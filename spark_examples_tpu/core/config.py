@@ -23,6 +23,48 @@ from dataclasses import dataclass, field
 EIGH_ITERS_DEFAULT = 8
 EIGH_OVERSAMPLE_DEFAULT = 32
 
+# Accuracy ladder of the PCoA/PCA eigensolve (spark_examples_tpu/solvers):
+# each rung trades accuracy for memory/passes. "exact" is the dense route
+# (materialized N x N Gram -> dense/randomized eigh); "sketch" folds a
+# low-rank range sketch Y = B@Omega into (N, rank) state DURING the single
+# variant pass and solves from the Nystrom core — no N x N array ever
+# exists; "corrected" re-streams the cohort sketch_iters more times as
+# subspace-iteration power steps before a Rayleigh solve. Declared here
+# (not in solvers/) because config cannot import solvers without a cycle.
+SOLVER_LADDER = ("sketch", "corrected", "exact")
+# Numeric twin of the ladder for the solver.rung telemetry gauge
+# (0 sketch, 1 corrected, 2 exact — the glossary contract).
+SOLVER_RUNG_ID = {rung: i for i, rung in enumerate(SOLVER_LADDER)}
+SKETCH_RANK_DEFAULT = 64
+SKETCH_ITERS_DEFAULT = 2
+
+# Metrics whose centered PCoA/PCA operator is an exact Gram of per-block
+# streamable features A_b — B = (J A)(J A)^T — which is what makes the
+# one-pass range sketch exact up to solver error: shared-alt (A = alt-
+# carrier indicators), grm (A = VanRaden-standardized Z, /nvar), dot
+# (A = raw masked values) and euclidean (ditto; exact when no calls are
+# missing — with missingness the sketch models zero-imputed dosages,
+# while the exact route's qc term keeps per-pair denominators). The
+# ratio metrics (ibs / ibs2 / king) finalize with ELEMENTWISE pair-count
+# divisions (d1/2m, phi = num/den) that are not bilinear in any streamed
+# feature — they mathematically require the materialized N x N and stay
+# on the exact rung.
+SKETCH_METRICS = ("shared-alt", "grm", "dot", "euclidean")
+
+
+def unsketchable_metric_error(metric: str, solver: str) -> str:
+    """THE rejection text for a non-sketchable metric — shared by the
+    config-time validation below and the runtime gate in
+    solvers/sketch.py (which also catches a ``metric=None`` driver
+    default resolving to ibs), so the two can never drift apart."""
+    return (
+        f"--solver {solver} does not support --metric {metric}: the "
+        "sketch streams an exact Gram factor per block, which exists "
+        f"for {' | '.join(SKETCH_METRICS)}; ratio metrics (ibs/ibs2/"
+        "king) finalize with elementwise pair-count divisions that "
+        "require the materialized N x N — use --solver exact for them"
+    )
+
 
 @dataclass(frozen=True)
 class ReferenceRange:
@@ -220,6 +262,65 @@ class ComputeConfig:
     stream_refresh_blocks: int = 0
     checkpoint_dir: str | None = None
     checkpoint_every_blocks: int = 0  # 0 disables partial-Gram checkpoints
+    # Eigensolve accuracy ladder (spark_examples_tpu/solvers; the
+    # --solver flag): "exact" = today's dense route; "sketch" = one-pass
+    # streaming range sketch + Nystrom solve, O(N * sketch_rank) solver
+    # memory, no N x N anywhere; "corrected" = sketch plus sketch_iters
+    # extra streamed passes (subspace-iteration power steps) + Rayleigh
+    # solve. The chosen rung is recorded in the model artifact and the
+    # solver.* telemetry.
+    solver: str = "exact"
+    sketch_rank: int = SKETCH_RANK_DEFAULT  # probe columns (>= num_pc)
+    sketch_iters: int = SKETCH_ITERS_DEFAULT  # extra passes (corrected)
+    sketch_seed: int = 0  # probe RNG seed (resume must keep it)
+
+    def __post_init__(self):
+        # Solver-knob validation AT CONFIG TIME, with the flag named —
+        # the PR-5 IngestConfig convention: a nonsense value must die
+        # here as a usage error, not hours later as a mid-stream shape
+        # error or a silently wrong subspace.
+        if self.solver not in SOLVER_LADDER:
+            raise ValueError(
+                f"bad compute config: --solver={self.solver!r} — expected "
+                f"one of {' | '.join(SOLVER_LADDER)} (the accuracy "
+                "ladder: sketch = one-pass range sketch, corrected = "
+                "+power-iteration passes, exact = dense N x N route)"
+            )
+
+        def _check(flag, value, lo, hi, why):
+            if not (isinstance(value, int) and lo <= value <= hi):
+                raise ValueError(
+                    f"bad compute config: {flag}={value!r} — expected an "
+                    f"integer in [{lo}, {hi}] ({why})"
+                )
+
+        _check("--sketch-rank", self.sketch_rank, 1, 65536,
+               "range-sketch probe columns; clamped to N at run time")
+        _check("--sketch-iters", self.sketch_iters, 0, 1000,
+               "extra streamed power-iteration passes of the corrected "
+               "rung; each is one full pass over the cohort")
+        _check("--sketch-seed", self.sketch_seed, -(2 ** 63), 2 ** 63 - 1,
+               "probe RNG seed; a resumed job must keep it")
+        if self.solver != "exact":
+            if self.sketch_rank < self.num_pc:
+                raise ValueError(
+                    f"bad compute config: --sketch-rank={self.sketch_rank} "
+                    f"< --num-pc={self.num_pc} — the sketch cannot recover "
+                    "more eigenpairs than it has probe columns; raise "
+                    "--sketch-rank (components + ~32 oversample is the "
+                    "usual shape)"
+                )
+            if self.solver == "corrected" and self.sketch_iters < 1:
+                raise ValueError(
+                    "bad compute config: --solver=corrected with "
+                    "--sketch-iters=0 is the plain sketch rung — ask for "
+                    "--solver=sketch, or give corrected >= 1 extra pass"
+                )
+            if self.metric is not None and self.metric not in SKETCH_METRICS:
+                raise ValueError(
+                    "bad compute config: "
+                    + unsketchable_metric_error(self.metric, self.solver)
+                )
 
 
 @dataclass

@@ -143,6 +143,14 @@ def rows_j(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(AXIS_J, None))
 
 
+def rows_flat(mesh: Mesh) -> NamedSharding:
+    """Sharding for an (N, r) skinny solver block with the SAMPLE axis
+    split over the whole mesh — the sketch solve's layout
+    (solvers/solve.py): every r x r contraction is a local product plus
+    one psum over the flattened (i, j) device list."""
+    return NamedSharding(mesh, P((AXIS_I, AXIS_J), None))
+
+
 def variants_flat(mesh: Mesh) -> NamedSharding:
     """Sharding for an (N, V) block with the variant axis split over the
     whole mesh — the data-parallel axis (reference: RDD partitions by

@@ -117,6 +117,19 @@ NAMES: dict[str, tuple[str, str]] = {
         "a replica dir, else re-compaction of the chunk's origin span — "
         "both digest-checked against the content address before install",
     ),
+    "solver.pass": (
+        "span",
+        "one streamed pass of the sketch solver (solvers/): the range "
+        "sketch Y = B@Q folded block-by-block over the whole cohort — "
+        "pass 0 against the random probes, later passes the corrected "
+        "rung's subspace-iteration power steps (args: index, rung)",
+    ),
+    "solver.solve": (
+        "span",
+        "the sketch solver's terminal solve: Nystrom eigenpairs "
+        "(single-pass rung) or Rayleigh Ritz pairs (corrected) from the "
+        "(N, rank) sketch state — rank-sized math, never an N x N eigh",
+    ),
     # -- instant events ---------------------------------------------------
     "fault": ("event", "a fault-injection spec fired (args: site, kind)"),
     "stream.snapshot": (
@@ -253,6 +266,12 @@ NAMES: dict[str, tuple[str, str]] = {
         "healed incidents also count store.verify_failures, so "
         "healed/verify_failures is the self-healing rate",
     ),
+    "solver.passes": (
+        "counter",
+        "streamed sketch-solver passes completed (1 for the sketch "
+        "rung, 1 + --sketch-iters for corrected; each is one full "
+        "variant pass over the cohort)",
+    ),
     "supervisor.restarts": (
         "counter",
         "supervised-child restarts (crash, injected kill, or watchdog "
@@ -318,6 +337,29 @@ NAMES: dict[str, tuple[str, str]] = {
         "gauge",
         "host->device transfers dispatched ahead of the yielded block "
         "in the K-deep feed (bounded by the transfer ring depth)",
+    ),
+    "solver.rung": (
+        "gauge",
+        "the accuracy-ladder rung this job's eigensolve ran "
+        "(0 sketch, 1 corrected, 2 exact) — the provenance the model "
+        "artifact records as a string",
+    ),
+    "solver.rank": (
+        "gauge",
+        "sketch probe columns actually used (--sketch-rank clamped to "
+        "N) — the r of the (N, r) solver state",
+    ),
+    "solver.state_bytes": (
+        "gauge",
+        "peak sketch-solver state residency (the y + q f32 leaves) — "
+        "THE solver memory number; compare solver.nxn_bytes_avoided "
+        "for what the dense route would have held",
+    ),
+    "solver.nxn_bytes_avoided": (
+        "gauge",
+        "bytes of N x N accumulator the dense route would have "
+        "allocated for this cohort/metric — the allocation the sketch "
+        "path exists to never make",
     ),
     # -- histograms -------------------------------------------------------
     "prefetch.put_wait_s": (

@@ -30,6 +30,9 @@ from spark_examples_tpu.ops.centering import center_matrix
 class PCAResult:
     coords: jnp.ndarray  # (N, k) projections onto top components
     eigenvalues: jnp.ndarray  # (k,) matrix eigenvalues, by descending |.|
+    # Accuracy-ladder rung that produced the eigenpairs (see
+    # models/pcoa.PCoAResult.solver) — "exact" for this dense route.
+    solver: str = "exact"
 
 
 @partial(jax.jit, static_argnames=("k",))

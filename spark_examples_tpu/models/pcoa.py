@@ -32,6 +32,12 @@ class PCoAResult:
     coords: jnp.ndarray  # (N, k) principal coordinates
     eigenvalues: jnp.ndarray  # (k,) descending
     proportion_explained: jnp.ndarray  # (k,) fraction of positive inertia
+    # Which accuracy-ladder rung produced the eigenpairs (core.config
+    # SOLVER_LADDER): "exact" for the dense/randomized routes in this
+    # module and parallel/pcoa_sharded; the streaming sketch solver
+    # (spark_examples_tpu/solvers) stamps its own rung. Recorded into
+    # the model artifact and telemetry by the job layer.
+    solver: str = "exact"
 
 
 @partial(jax.jit, static_argnames=("k", "method", "iters", "oversample"))

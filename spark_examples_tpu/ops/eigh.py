@@ -153,8 +153,19 @@ def eigh_flops(
       Rayleigh eigh (negligible) — crediting the dense count here would
       inflate the metric by orders of magnitude (the whole point of the
       randomized path is to do fewer FLOPs).
+    - ``sketch`` (the streaming sketch solver, spark_examples_tpu/
+      solvers): ONLY the solve-stage residue — one shifted CholeskyQR2
+      (~6 n p^2) per BETWEEN-pass boundary (passes - 1 of them; the
+      single-pass rung runs none) plus the terminal Nystrom/Rayleigh
+      (~4 n p^2); its B @ Q products were streamed through the variant
+      pass and are credited to gram_flops by the pass loop, so counting
+      them here would double-bill. ``iters`` = passes, ``k + oversample``
+      = the sketch rank.
     """
     if method == "randomized":
         p = k + oversample
         return (iters + 2) * 2.0 * n * n * p + (iters + 1) * 4.0 * n * p * p
+    if method == "sketch":
+        p = k + oversample
+        return max(iters - 1, 0) * 6.0 * n * p * p + 4.0 * n * p * p
     return 9.0 * float(n) ** 3

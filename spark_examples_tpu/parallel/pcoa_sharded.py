@@ -166,7 +166,10 @@ def pca_coords_sharded(
         check_shardings=check_shardings, timer=timer,
     )
     coords = vecs * vals[None, :]  # projection C v = lambda v
-    return PCAResult(coords, vals)
+    # The tile2d randomized route is the "exact" rung of the accuracy
+    # ladder: it materializes the (tiled) N x N and solves it — the
+    # sketch rungs (spark_examples_tpu/solvers) never build it at all.
+    return PCAResult(coords, vals, solver="exact")
 
 
 def assert_tiled(x: jax.Array, plan: GramPlan, what: str) -> None:
@@ -218,4 +221,4 @@ def pcoa_coords_sharded(
     )
     coords = coords_from_eigpairs(vals, vecs)
     prop = jnp.maximum(vals, 0.0) / jnp.maximum(trace, 1e-30)
-    return PCoAResult(coords, vals, prop)
+    return PCoAResult(coords, vals, prop, solver="exact")

@@ -18,7 +18,12 @@ import numpy as np
 import jax
 
 from spark_examples_tpu.parallel.multihost import fetch_replicated
-from spark_examples_tpu.core.config import EIGH_ITERS_DEFAULT, JobConfig
+from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core.config import (
+    EIGH_ITERS_DEFAULT,
+    SOLVER_RUNG_ID,
+    JobConfig,
+)
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.models.pca import fit_pca
 from spark_examples_tpu.models.pcoa import fit_pcoa
@@ -74,6 +79,12 @@ def pcoa_job(
             "silently wrong coordinates — fit the model from a cohort "
             "stream instead"
         )
+    if matrix_path is not None and job.compute.solver != "exact":
+        raise ValueError(
+            "--solver sketch/corrected streams the cohort to avoid "
+            "materializing N x N; a persisted --matrix-path IS the "
+            "materialized matrix — consume it with --solver exact"
+        )
     if matrix_path is not None:
         sample_ids, m, file_kind = pio.read_matrix(matrix_path)
         kind = matrix_kind if matrix_kind != "auto" else (file_kind or "distance")
@@ -96,6 +107,8 @@ def pcoa_job(
                 from spark_examples_tpu.pipelines.runner import build_source
 
                 source = build_source(job.ingest)
+        if job.compute.solver != "exact":
+            return _sketch_route(job, source, timer, kind="pcoa")
         routed = _pcoa_device_route(job, source, timer)
         if routed is not None:
             return routed
@@ -134,7 +147,22 @@ def _maybe_save_model(job, dist, coords, vals, sample_ids) -> None:
     from spark_examples_tpu.pipelines.project import save_model
 
     save_model(job.model_path, coords, vals, fetch_replicated(dist),
-               sample_ids, job.compute.metric or "ibs")
+               sample_ids, job.compute.metric or "ibs",
+               solver=job.compute.solver)
+
+
+def _sketch_route(job: JobConfig, source, timer, kind: str) -> CoordsOutput:
+    """The sketch/corrected rungs of the accuracy ladder (solvers/):
+    streamed range sketch + Nystrom/Rayleigh solve, no N x N anywhere.
+    ``method="sketch"`` threads the solver-matched FLOP credit through
+    ``_emit_coords`` (the streamed passes' FLOPs were already credited
+    to gram_flops by the pass loop)."""
+    from spark_examples_tpu.solvers import run_sketch_solve
+
+    res = run_sketch_solve(job, source, timer, kind=kind)
+    return _emit_coords(job, res.sample_ids, res.coords, res.eigenvalues,
+                        timer, res.n_variants, method="sketch",
+                        eigh_iters=res.passes, proportion=res.proportion)
 
 
 def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
@@ -150,11 +178,24 @@ def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,
     # FLOP credit must match the solver actually run (the randomized
     # path's whole point is doing far fewer FLOPs than dense ~9n^3) —
     # including the probe width k + oversample, which scales every
-    # B @ Q product (ADVICE r5 finding 3).
+    # B @ Q product (ADVICE r5 finding 3). The sketch method's probe
+    # width is --sketch-rank (its B @ Q products were streamed and
+    # already credited to gram_flops; this is the solve-stage residue),
+    # so its effective oversample is rank - k and ``eigh_iters`` carries
+    # the pass count.
+    oversample = (job.compute.sketch_rank - job.compute.num_pc
+                  if method == "sketch" else job.compute.eigh_oversample)
     timer.add("eigh_flops", eigh_flops(len(sample_ids), method=method,
                                        k=job.compute.num_pc,
-                                       oversample=job.compute.eigh_oversample,
+                                       oversample=oversample,
                                        iters=eigh_iters))
+    # Every coords-emitting job records its accuracy-ladder rung — the
+    # sketch driver also publishes it up front, but the exact routes
+    # only pass through here, and a rung that is only observable for
+    # two of its three values is a glossary lie.
+    telemetry.gauge_set(
+        "solver.rung", float(SOLVER_RUNG_ID[job.compute.solver])
+    )
     out = CoordsOutput(
         sample_ids, fetch_replicated(coords), fetch_replicated(vals), timer,
         n_variants,
@@ -263,6 +304,8 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
                 from spark_examples_tpu.pipelines.runner import build_source
 
                 source = build_source(job.ingest)
+        if job.compute.solver != "exact":
+            return _sketch_route(job, source, timer, kind="pca")
         plan = runner.plan_for_job(job, source)
         if plan.mode == "tile2d" and job.model_path:
             # Fail BEFORE streaming (projection needs the dense
@@ -312,6 +355,11 @@ def variants_pca_job(job: JobConfig, source=None) -> CoordsOutput:
 
     # cpu-reference backend only (the jax backend always returned above):
     # the measured MLlib-route oracle.
+    if job.compute.solver != "exact":
+        raise ValueError(
+            "--solver sketch/corrected runs on the jax backend; the CPU "
+            "oracle implements the dense reference route only"
+        )
     sim = run_similarity(job, source=source)
     with sim.timer.phase("eigh"):
         coords, vals = oracle.pca_mllib_route(
@@ -329,7 +377,7 @@ def _maybe_save_pca_model(job, similarity, coords, vals, sample_ids):
     from spark_examples_tpu.pipelines.project import save_pca_model
 
     save_pca_model(job.model_path, coords, vals, fetch_replicated(similarity),
-                   sample_ids)
+                   sample_ids, solver=job.compute.solver)
 
 
 def _eigh_method(eigh_mode: str, n: int) -> str:

@@ -108,6 +108,13 @@ class ProjectionModel:
     grand: float
     sample_ids: list[str]
     schema_version: int = SCHEMA_VERSION
+    # Which accuracy-ladder rung fitted the eigenpairs (core.config
+    # SOLVER_LADDER). Optional in the archive (older files predate the
+    # ladder and were all dense): absent reads as "exact". Today only
+    # exact-rung models exist on disk — the sketch rungs cannot persist
+    # the dense centering statistics projection needs — but the field is
+    # the forward-compatible provenance record the ladder mandates.
+    solver: str = "exact"
 
     @property
     def n_ref(self) -> int:
@@ -192,6 +199,8 @@ def load_model(path: str) -> ProjectionModel:
                 grand=float(mdl[gr]),
                 sample_ids=[str(s) for s in mdl["sample_ids"]],
                 schema_version=version,
+                solver=(str(mdl["solver"]) if "solver" in names
+                        else "exact"),
             )
     except (ValueError, OSError, zipfile.BadZipFile) as e:
         # Member reads of a truncated-but-openable archive fail here.
@@ -242,12 +251,14 @@ def save_model(
     distance: np.ndarray,
     sample_ids: list[str],
     metric: str,
+    solver: str = "exact",
 ) -> None:
     """Persist a fitted PCoA embedding for later projection.
 
     ``coords`` = V sqrt(lambda) (the job output), so V is recovered by
     dividing out sqrt(lambda); components with lambda <= 0 are dropped
     (they carry no metric information and their V is undefined).
+    ``solver`` records which accuracy-ladder rung fitted the eigenpairs.
     """
     vals = np.asarray(eigenvalues, np.float64)
     keep = vals > 0
@@ -263,6 +274,7 @@ def save_model(
         d2_grand=np.float64(d2.mean()),
         sample_ids=np.asarray(sample_ids),
         metric=np.asarray(metric),
+        solver=np.asarray(solver),
     )
 
 
@@ -272,6 +284,7 @@ def save_pca_model(
     eigenvalues: np.ndarray,
     similarity: np.ndarray,
     sample_ids: list[str],
+    solver: str = "exact",
 ) -> None:
     """Persist a fitted PCA embedding (the flagship driver) for later
     projection.
@@ -280,6 +293,7 @@ def save_pca_model(
     recovered by dividing out lambda; zero eigenvalues are dropped.
     Projection of a new row needs the REFERENCE similarity's column
     means and grand mean (the J ... J centering applied to cross rows).
+    ``solver`` records which accuracy-ladder rung fitted the eigenpairs.
     """
     vals = np.asarray(eigenvalues, np.float64)
     keep = np.abs(vals) > 1e-12
@@ -295,6 +309,7 @@ def save_pca_model(
         s_grand=np.float64(s.mean()),
         sample_ids=np.asarray(sample_ids),
         metric=np.asarray("shared-alt"),
+        solver=np.asarray(solver),
     )
 
 

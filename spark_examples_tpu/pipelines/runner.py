@@ -457,6 +457,68 @@ def _finish_gram_multihost(job, source, timer, plan, update, acc,
     return GramRun(acc, plan, source.sample_ids, metric, timer, n_variants)
 
 
+def run_sketch_pass(
+    job: JobConfig,
+    source,
+    timer: PhaseTimer,
+    plan: gram_sharded.GramPlan,
+    update,
+    state: dict,
+    start_variant: int = 0,
+    packed: bool = False,
+    block_flops=None,
+    save_cb=None,
+):
+    """One streamed pass of the sketch solver (solvers/): the SAME
+    staged-ring feed, ``gram.block`` spans, cursor semantics, and
+    checkpoint cadence as :func:`run_gram` — only the accumulator is the
+    (N, r) sketch state instead of N x N pieces, so the supervisor's
+    heartbeat progress token, the bench telemetry digest, and the
+    kill/resume machinery all see a sketch job exactly as they see a
+    gram job.
+
+    ``block_flops(v_effective)``: per-block FLOP credit (the sketch's
+    two skinny matmuls — crediting the dense gram count here would fake
+    a ~N/r speedup). ``save_cb(state, cursor)``: checkpoint hook, called
+    at the job's ``checkpoint_every_blocks`` cadence after a hard sync;
+    the driver owns the manifest extras (pass index, probe seed).
+
+    Returns ``(state, n_variants)`` with the state hard-synced.
+    """
+    cfg = job.compute
+    bv = job.ingest.block_variants
+    n_shards = plan.block_shards
+    blocks_done = 0
+    last_stop = start_variant
+    with timer.phase("gram"):
+        sp = telemetry.begin("gram.block", cat="gram")
+        for block, meta in stream_to_device(
+            source, bv, start_variant, sharding=plan.block_sharding,
+            pad_multiple=n_shards, pack=packed,
+            prefetch=job.ingest.prefetch_blocks,
+        ):
+            state = update(state, block)
+            if block_flops is not None:
+                v_eff = block.shape[1] * (4 if packed else 1)
+                timer.add("gram_flops", block_flops(v_eff))
+            timer.add("ingest_bytes", block.size)
+            blocks_done += 1
+            last_stop = meta.stop
+            if (
+                save_cb is not None
+                and cfg.checkpoint_every_blocks
+                and blocks_done % cfg.checkpoint_every_blocks == 0
+            ):
+                hard_sync(state)
+                save_cb(state, meta.stop)
+            sp.end(index=blocks_done, stop=meta.stop)
+            sp = telemetry.begin("gram.block", cat="gram")
+        sp.cancel()  # the final begin only saw the stream's end
+        state = hard_sync(state)
+    n_variants = last_stop if last_stop > 0 else source.n_variants
+    return state, n_variants
+
+
 def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
     """Stream the cohort and produce the pairwise similarity + distance
     matrices (the SimilarityMatrix job surface, SURVEY.md §3.2)."""

@@ -121,6 +121,14 @@ def incremental_pcoa_job(
             "eigh_mode='dense' would be silently ignored — use the batch "
             "pcoa job for a dense solve"
         )
+    if cfg.solver != "exact":
+        raise ValueError(
+            "--solver sketch/corrected applies to the batch pcoa/pca "
+            "solve; the streaming incremental route tracks its own warm "
+            "subspace over the LIVE N x N accumulator and would silently "
+            "shadow the sketch state — drop --stream-refresh-blocks to "
+            "run the sketch solver, or --solver exact to stream snapshots"
+        )
     timer = PhaseTimer()
     if source is None:
         with timer.phase("ingest_setup"):

@@ -1,0 +1,37 @@
+"""Streaming sketch solver — PCoA/PCA at 100k+ samples, no N x N.
+
+The accuracy ladder (``--solver``, ``core.config.SOLVER_LADDER``):
+
+- ``sketch``    — one streamed pass folds a low-rank range sketch
+                  ``Y = B @ Omega`` into (N, rank) state; single-pass
+                  Nystrom eigenpairs. O(N * rank) solver memory.
+- ``corrected`` — ``sketch`` plus ``--sketch-iters`` extra streamed
+                  passes (subspace-iteration power steps) and a
+                  Rayleigh solve: each pass multiplies the residual
+                  error by ~(lambda_{r+1}/lambda_k)^2.
+- ``exact``     — the dense route (materialized Gram -> dense or
+                  randomized eigh), unchanged from before this module.
+
+Module map: :mod:`~spark_examples_tpu.solvers.sketch` (streamed
+accumulator), :mod:`~spark_examples_tpu.solvers.solve` (sharded
+CholeskyQR2 / Nystrom / Rayleigh solve stage),
+:mod:`~spark_examples_tpu.solvers.driver` (pass orchestration,
+checkpoint/resume, ladder dispatch — what ``pipelines/jobs.py`` calls).
+"""
+
+from spark_examples_tpu.core.config import SKETCH_METRICS, SOLVER_LADDER
+from spark_examples_tpu.solvers.driver import (
+    RUNG_ID,
+    SketchSolveResult,
+    run_sketch_solve,
+)
+from spark_examples_tpu.solvers.sketch import check_sketchable
+
+__all__ = [
+    "SKETCH_METRICS",
+    "SOLVER_LADDER",
+    "RUNG_ID",
+    "SketchSolveResult",
+    "run_sketch_solve",
+    "check_sketchable",
+]

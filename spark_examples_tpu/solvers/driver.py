@@ -1,0 +1,200 @@
+"""The sketch-solver job driver: passes -> solve, under the ladder.
+
+Orchestrates the pieces of the subsystem into the pipeline-facing call
+(:func:`run_sketch_solve`, consumed by ``pipelines/jobs.py``):
+
+- stream 1 + extra passes over the cohort through
+  :func:`pipelines.runner.run_sketch_pass` (the same staged-ring feed,
+  ``gram.block`` spans, and checkpoint cadence as the gram routes — a
+  supervised sketch job is killed/resumed by exactly the machinery that
+  supervises a gram job);
+- between passes of the ``corrected`` rung, orthonormalize the sketch
+  (shifted CholeskyQR2) and iterate — textbook subspace iteration where
+  every B@Q product is a streamed pass, never a materialized matmul;
+- terminal solve per rung: single-pass Nystrom (``sketch``) or Rayleigh
+  Ritz pairs (``corrected``); ``exact`` never reaches this module.
+
+Checkpoint/resume: the sketch state is an ordinary accumulator dict to
+``core/checkpoint.py`` (leaves ``y``/``qc``/``trace``/``nvar`` plus the
+``passno`` cursor), namespaced under ``solver:<metric>`` so a sketch
+checkpoint can never be confused with a gram one, with the rung/rank/
+seed recorded as the manifest's ``extra`` — a resume under different
+probe settings is rejected, not silently mixed. Probes themselves are
+re-derived from ``--sketch-seed``, so a killed job resumes
+bit-identically (tests/test_kill_matrix.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from spark_examples_tpu.core import checkpoint as ckpt
+from spark_examples_tpu.core import meshes, telemetry
+from spark_examples_tpu.core.config import SOLVER_RUNG_ID, JobConfig
+from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
+from spark_examples_tpu.ops import gram
+from spark_examples_tpu.ops.eigh import coords_from_eigpairs
+from spark_examples_tpu.parallel.gram_sharded import GramPlan
+from spark_examples_tpu.pipelines import runner as R
+from spark_examples_tpu.solvers import sketch, solve
+
+RUNG_ID = SOLVER_RUNG_ID  # re-exported; the numbers live with the ladder
+
+_CKPT_LEAVES = sketch.STATE_LEAVES + ("passno",)
+
+
+@dataclass
+class SketchSolveResult:
+    """What the pipeline needs back: host-resident eigenpairs/coords
+    plus the provenance the model artifact and telemetry record."""
+
+    sample_ids: list[str]
+    eigenvalues: np.ndarray  # (k,) descending
+    coords: np.ndarray  # (N, k)
+    proportion: np.ndarray | None  # PCoA only (share of total inertia)
+    n_variants: int
+    rung: str
+    rank: int
+    passes: int
+
+
+def sketch_plan(job: JobConfig) -> GramPlan:
+    """The sketch's distribution plan: blocks variant-sharded over the
+    mesh exactly like the gram path, state replicated. Never tile2d —
+    there is no N x N accumulator to tile, so neither the acc-budget
+    heuristic nor the sample-divisibility constraint applies."""
+    meshes.maybe_init_distributed()
+    mesh = meshes.make_mesh(shape=job.compute.mesh_shape)
+    mode = "replicated" if mesh.devices.size == 1 else "variant"
+    return GramPlan(mesh, mode)
+
+
+def run_sketch_solve(job: JobConfig, source, timer: PhaseTimer,
+                     kind: str) -> SketchSolveResult:
+    """Run the full sketch/corrected solve for a pcoa or pca job."""
+    cfg = job.compute
+    metric = "shared-alt" if kind == "pca" else (cfg.metric or "ibs")
+    sketch.check_sketchable(metric, cfg.solver)
+    if cfg.backend == "cpu-reference":
+        raise ValueError(
+            "--solver sketch/corrected runs on the jax backend; the CPU "
+            "oracle implements the dense reference route only"
+        )
+    if job.model_path:
+        raise ValueError(
+            "--save-model needs the dense distance/similarity matrix for "
+            "the projection centering statistics, which the sketch route "
+            "never materializes — fit the model with --solver exact"
+        )
+    plan = sketch_plan(job)
+    if jax.process_count() > 1:
+        raise ValueError(
+            "--solver sketch/corrected is single-process for now (the "
+            "state psums span the local mesh); run multi-host jobs with "
+            "--solver exact"
+        )
+    n = source.n_samples
+    rank = min(cfg.sketch_rank, n)
+    passes = 1 + (cfg.sketch_iters if cfg.solver == "corrected" else 0)
+    is_grm = metric == "grm"
+    packed = cfg.pack_stream == "packed" or (
+        cfg.pack_stream == "auto" and metric in gram.DOSAGE_METRICS
+    )
+    update = sketch.make_update(plan, metric, packed=packed,
+                                grm_precise=cfg.grm_precise)
+
+    # The memory story, in telemetry: what this run holds vs what the
+    # dense route would have had to allocate for the same cohort.
+    telemetry.gauge_set("solver.rung", RUNG_ID[cfg.solver])
+    telemetry.gauge_set("solver.rank", float(rank))
+    telemetry.gauge_set("solver.state_bytes",
+                        float(sketch.state_bytes(n, rank)))
+    telemetry.gauge_set("solver.nxn_bytes_avoided",
+                        float(sketch.nxn_bytes(n, metric)))
+
+    metric_tag = f"solver:{metric}"
+    extra = {"solver": cfg.solver, "kind": kind, "rank": int(rank),
+             "iters": int(cfg.sketch_iters), "seed": int(cfg.sketch_seed)}
+    bv = job.ingest.block_variants
+
+    def save_state(state: dict, cursor: int, pass_idx: int) -> None:
+        acc = dict(state)
+        acc["passno"] = np.int64(pass_idx)
+        ckpt.save(cfg.checkpoint_dir, acc, cursor, metric_tag, bv,
+                  source.sample_ids, extra=extra)
+
+    state, start_pass, start_variant = None, 0, 0
+    if cfg.checkpoint_dir:
+        restored = ckpt.load(cfg.checkpoint_dir, metric_tag,
+                             source.sample_ids, block_variants=bv,
+                             leaves=list(_CKPT_LEAVES), expect_extra=extra)
+        if restored is not None:
+            acc, start_variant, _stats = restored
+            start_pass = int(np.asarray(acc.pop("passno")))
+            repl = meshes.replicated(plan.mesh)
+            state = {k: jax.device_put(np.asarray(v), repl)
+                     for k, v in acc.items()}
+    if state is None:
+        state = sketch.init_state(plan, n, rank, cfg.sketch_seed)
+
+    checkpointing = bool(cfg.checkpoint_dir and cfg.checkpoint_every_blocks)
+    n_variants = 0
+    yb = tr = None
+    for pass_idx in range(start_pass, passes):
+        cb = None
+        if checkpointing:
+            def cb(st, cur, _p=pass_idx):
+                save_state(st, cur, _p)
+        with telemetry.span("solver.pass", cat="solver", index=pass_idx,
+                            rung=cfg.solver):
+            state, n_variants = R.run_sketch_pass(
+                job, source, timer, plan, update, state,
+                start_variant=start_variant if pass_idx == start_pass else 0,
+                packed=packed,
+                block_flops=lambda v: sketch.flops_per_block(n, v, rank),
+                save_cb=cb,
+            )
+        telemetry.count("solver.passes")
+        yb, tr = sketch.finalize_pass(state["y"], state["trace"],
+                                      state["nvar"], is_grm=is_grm)
+        if pass_idx + 1 < passes:
+            # Subspace iteration: next pass tracks the orthonormalized
+            # range of this one. The output of orthonormalize stays
+            # column-centered (right multiplication), so it is already
+            # the J q the update streams against.
+            qc = solve.orthonormalize(yb, plan)
+            state = sketch.reset_for_pass(plan, state, qc)
+            if checkpointing:
+                save_state(state, 0, pass_idx + 1)
+
+    k = cfg.num_pc
+    with timer.phase("eigh"):
+        with telemetry.span("solver.solve", cat="solver", rung=cfg.solver):
+            if cfg.solver == "sketch":
+                vals, vecs = solve.nystrom_eigs(yb, state["qc"], k, plan)
+            else:
+                vals, vecs = solve.rayleigh_eigs(yb, state["qc"], k, plan)
+            vals, vecs, tr = hard_sync((vals, vecs, tr))
+
+    vals_np = np.asarray(vals)
+    if kind == "pca":
+        # The PCA driver's projection convention: coords = C v = lambda v
+        # (B is PSD for every sketchable metric, so top == top-|lambda|).
+        coords = np.asarray(vecs) * vals_np[None, :]
+        prop = None
+    else:
+        coords = np.asarray(coords_from_eigpairs(vals, vecs))
+        prop = np.maximum(vals_np, 0.0) / max(float(np.asarray(tr)), 1e-30)
+    return SketchSolveResult(
+        sample_ids=source.sample_ids,
+        eigenvalues=vals_np,
+        coords=coords,
+        proportion=prop,
+        n_variants=n_variants,
+        rung=cfg.solver,
+        rank=int(rank),
+        passes=passes,
+    )

@@ -1,0 +1,233 @@
+"""Streaming range sketch: the N x N-free half of the sketch solver.
+
+The dense routes accumulate N x N Gram pieces and eigensolve them —
+which caps the cohort at single-chip HBM (ROADMAP item 1). This module
+replaces the N x N state with an (N, r) **range sketch** folded
+block-by-block during the SAME single variant pass, following the
+distributed randomized PCA/SVD construction of arXiv:1612.08709 and the
+TPU dense-linear-algebra tactics of arXiv:2112.09017 (PAPERS.md):
+
+For every sketchable metric (``core.config.SKETCH_METRICS``) the
+centered solve operator is an exact Gram of per-block streamable
+features ``A = [A_1 | A_2 | ...]``:
+
+    B  =  (J A)(J A)^T / denom,      J = I - 11^T/N
+
+- ``shared-alt``: ``A_b = [G_b >= 1]`` (alt-carrier indicators); the PCA
+  driver's centered similarity ``J S J`` and the PCoA operator coincide.
+- ``grm``: ``A_b = Z_b`` (VanRaden standardization,
+  :func:`ops.gram.grm_standardize` — the SAME per-block definition the
+  exact route uses), ``denom = nvar``.
+- ``dot`` / ``euclidean``: ``A_b = max(G_b, 0)`` masked raw values
+  (euclidean is exact when no calls are missing; with missingness the
+  sketch models zero-imputed dosages).
+
+Because ``B J = J B = B``, a matvec block against any probe block Q is
+
+    B Q = J * sum_b A_b (A_b^T (J Q)) / denom
+
+so the streamed update per genotype block is two skinny matmuls —
+``(v, N) x (N, r)`` then ``(N, v) x (v, r)`` — at ``4 N v r`` FLOPs
+instead of the dense route's ``2 N^2 v``: for N = 100k, r = 64 that is
+the difference between representable and not. Under a multi-device plan
+the block arrives variant-sharded exactly as in the gram path and the
+``A_b @ W`` contraction psums over the mesh; the sketch state stays
+replicated (an (N, r) f32 leaf is ~25 MB at N = 100k — noise).
+
+The state is a plain accumulator dict (``y``/``qc``/``trace``/``nvar``)
+so it rides the existing checkpoint machinery unchanged: deterministic
+per-block adds, resumable from any block cursor bit-identically
+(tests/test_kill_matrix.py pins this under the supervisor).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.core.config import (
+    SKETCH_METRICS,
+    unsketchable_metric_error,
+)
+from spark_examples_tpu.ops import gram as gram_ops
+from spark_examples_tpu.parallel.gram_sharded import GramPlan
+
+# Checkpointable accumulator leaves (core/checkpoint.py saves them like
+# any gram accumulator; the pass index rides in the manifest's extra).
+STATE_LEAVES = ("y", "qc", "trace", "nvar")
+
+
+def check_sketchable(metric: str, solver: str) -> None:
+    """The one runtime gate (config-time validation cannot see a
+    ``metric=None`` driver default resolve to ibs). Same message text
+    as the config-time rejection — one builder, no drift."""
+    if metric not in SKETCH_METRICS:
+        raise ValueError(unsketchable_metric_error(metric, solver))
+
+
+def probes(n: int, rank: int, seed: int) -> jnp.ndarray:
+    """Deterministic (N, min(rank, N)) Gaussian probe block — recomputed
+    from ``--sketch-seed`` on resume, never checkpointed (the state that
+    IS checkpointed already absorbed it)."""
+    key = jax.random.key(seed)
+    return jax.random.normal(key, (n, min(rank, n)), jnp.float32)
+
+
+def center_cols(x: jnp.ndarray) -> jnp.ndarray:
+    """J x for the sample-axis centering operator J = I - 11^T/N:
+    subtract each column's mean over samples. The only form of J the
+    sketch ever applies — always to an (N, r) skinny block, never to
+    anything N x N."""
+    return x - x.mean(axis=0, keepdims=True)
+
+
+def _features(block, metric: str, grm_precise: bool):
+    """(N, v) int8 dosages -> (A_b, kept): the streamed Gram factor's
+    columns for this block, plus the variant count feeding the grm
+    denominator. Padding columns (all MISSING) produce all-zero feature
+    columns — zero contribution to y, trace, and nvar alike."""
+    if metric == "shared-alt":
+        a = (block >= 1).astype(jnp.float32)
+        kept = jnp.float32(0.0)  # denominator unused
+    elif metric == "grm":
+        # Same standardization as the exact route; the sketch's matmuls
+        # then run f32 regardless of grm_precise (they are ~N/r cheaper
+        # than the dense update, so there is no rate to buy back).
+        a, keep = gram_ops.grm_standardize(block, grm_precise)
+        a = a.astype(jnp.float32)
+        kept = keep.sum().astype(jnp.float32)
+    elif metric in ("dot", "euclidean"):
+        a = jnp.where(block >= 0, block, 0).astype(jnp.float32)
+        kept = jnp.float32(0.0)
+    else:  # static arg — a typo dies at trace time, not as wrong math
+        raise ValueError(f"metric {metric!r} is not sketchable")
+    return a, kept
+
+
+def _update_impl(state, block, metric: str, packed: bool,
+                 grm_precise: bool):
+    """One block into the sketch: y += A_b (A_b^T qc), trace/nvar ride
+    along. ``trace`` accumulates trace(B*denom) = ||J A||_F^2 =
+    sum_v (||a_v||^2 - (1^T a_v)^2 / N) — the PCoA total-inertia
+    denominator, streamed without any N x N."""
+    if packed:
+        from spark_examples_tpu.ingest.bitpack import unpack_dosages
+
+        block = unpack_dosages(block)
+    a, kept = _features(block, metric, grm_precise)
+    qc = state["qc"]
+    # (v, r): contract the sample axis (replicated) — local everywhere.
+    w = jax.lax.dot_general(
+        a, qc, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # (N, r): contract the (possibly mesh-sharded) variant axis — under
+    # a multi-device plan XLA inserts the per-block psum here, the same
+    # collective pattern as the gram accumulation.
+    y = state["y"] + jax.lax.dot_general(
+        a, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    af = a.astype(jnp.float32)
+    colsum = af.sum(axis=0)
+    n = a.shape[0]
+    tr = state["trace"] + (af * af).sum() - (colsum * colsum).sum() / n
+    return {"y": y, "qc": qc, "trace": tr, "nvar": state["nvar"] + kept}
+
+
+@lru_cache(maxsize=64)
+def _jitted_update(plan: GramPlan, metric: str, packed: bool,
+                   grm_precise: bool):
+    repl = meshes.replicated(plan.mesh)
+    state_sh = {"y": repl, "qc": repl, "trace": repl, "nvar": repl}
+    return jax.jit(
+        partial(_update_impl, metric=metric, packed=packed,
+                grm_precise=grm_precise),
+        in_shardings=(state_sh, plan.block_sharding),
+        out_shardings=state_sh,
+        donate_argnums=(0,),
+    )
+
+
+def make_update(plan: GramPlan, metric: str, packed: bool = False,
+                grm_precise: bool = False):
+    """Jitted ``(state, block) -> state`` with the plan's block transport
+    pinned — the sketch twin of ``gram_sharded.make_update``. Blocks
+    normally arrive already placed by ``stream_to_device``; host arrays
+    are padded/placed here the same way the gram update does."""
+    check_sketchable(metric, "sketch")
+    jitted = _jitted_update(plan, metric, packed, grm_precise)
+    n_shards = plan.block_shards
+
+    def update(state, block):
+        if not (isinstance(block, jax.Array)
+                and block.sharding == plan.block_sharding):
+            block = np.asarray(block)
+            if block.shape[1] % n_shards:
+                from spark_examples_tpu.ingest.prefetch import (
+                    pad_block, pad_packed,
+                )
+
+                width = -(-block.shape[1] // n_shards) * n_shards
+                block = (pad_packed(block, width) if packed
+                         else pad_block(block, width))
+            block = jax.device_put(block, plan.block_sharding)
+        return jitted(state, block)
+
+    return update
+
+
+def init_state(plan: GramPlan, n: int, rank: int, seed: int) -> dict:
+    """Fresh sketch state: zero sketch, centered probes, zero stats."""
+    repl = meshes.replicated(plan.mesh)
+    qc = center_cols(probes(n, rank, seed))
+    return {
+        "y": jax.device_put(jnp.zeros((n, min(rank, n)), jnp.float32), repl),
+        "qc": jax.device_put(qc, repl),
+        "trace": jax.device_put(jnp.zeros((), jnp.float32), repl),
+        "nvar": jax.device_put(jnp.zeros((), jnp.float32), repl),
+    }
+
+
+def reset_for_pass(plan: GramPlan, state: dict, qc: jnp.ndarray) -> dict:
+    """Fresh accumulators for the next streamed pass, tracking ``qc``
+    (the orthonormalized subspace the corrected rung iterates)."""
+    repl = meshes.replicated(plan.mesh)
+    return {
+        "y": jax.device_put(jnp.zeros_like(state["y"]), repl),
+        "qc": jax.device_put(qc, repl),
+        "trace": jax.device_put(jnp.zeros((), jnp.float32), repl),
+        "nvar": jax.device_put(jnp.zeros((), jnp.float32), repl),
+    }
+
+
+@partial(jax.jit, static_argnames=("is_grm",))
+def finalize_pass(y, trace, nvar, is_grm: bool = False):
+    """Completed-pass accumulators -> (B @ q_in, trace(B)): apply the
+    outer J and the metric denominator. Skinny math only."""
+    denom = jnp.maximum(nvar, 1.0) if is_grm else jnp.float32(1.0)
+    return center_cols(y) / denom, trace / denom
+
+
+def state_bytes(n: int, rank: int) -> int:
+    """Peak solver-state residency: y + qc f32 leaves (the scalars are
+    noise). THE 'peak solver memory' number bench reports — compare
+    against nxn_bytes(...) for what the dense route would have held."""
+    r = min(rank, n)
+    return 2 * n * r * 4
+
+
+def nxn_bytes(n: int, metric: str) -> int:
+    """What the dense route's accumulators would have allocated for this
+    cohort/metric — the allocation the sketch path exists to avoid."""
+    n_acc = max(len(gram_ops.PIECES_FOR_METRIC.get(metric, ("zz",))), 1)
+    return 4 * n * n * n_acc
+
+
+def flops_per_block(n: int, v: int, rank: int) -> float:
+    """The two skinny matmuls of one block's sketch update."""
+    return 4.0 * n * v * min(rank, n)

@@ -1,0 +1,169 @@
+"""Distributed solve stage: eigenpairs from the sketch, no N x N eigh.
+
+Everything here operates on (N, r) skinny blocks and (r, r) cores — the
+N x N operator only ever existed implicitly, as the streamed passes in
+:mod:`solvers.sketch`. Under a multi-device plan the skinny blocks are
+row-sharded over the flattened mesh (``meshes.rows_flat``): each (r, r)
+contraction (``Y^T Y``, ``Q^T Y``) lowers to a local product plus one
+psum over the mesh — the per-iteration collective the design calls for
+— while the (r, r) math (Cholesky, triangular solve, eigh) runs
+replicated: at r ~ 64 it is microseconds, irrelevant next to a streamed
+pass. This is the TPU-shaped division of labor of arXiv:2112.09017
+applied to the randomized solve of arXiv:1612.08709.
+
+Two terminal solves, one per ladder rung:
+
+- :func:`nystrom_eigs` — single-pass rung: with ``Y = B Omega`` and the
+  core ``C = Omega^T Y = Omega^T B Omega``, the Nystrom approximation
+  ``B ~ Y C^+ Y^T`` yields eigenpairs from a shifted Cholesky of C, a
+  triangular solve against Y, and an (r, r) eigh.
+- :func:`rayleigh_eigs` — corrected rung: the last streamed pass was
+  ``Y = B Q`` with Q orthonormal (subspace iteration), so the Rayleigh
+  quotient ``T = Q^T Y`` gives Ritz pairs directly.
+
+Orthonormalization between passes is **shifted CholeskyQR2** — two
+rounds of ``W = chol(Y^T Y + eps I)^-T`` — the communication-minimal
+tall-skinny QR (one psum per round, no column-by-column Householder
+traffic), robust at f32 for the conditioning subspace iteration
+produces.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.parallel.gram_sharded import GramPlan
+
+# Relative Cholesky shift: large enough to keep chol finite on a
+# rank-deficient core at f32, small enough to be noise against any
+# eigenvalue the sketch can resolve at all.
+_SHIFT = 1e-6
+
+
+def _shifted_chol(g: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(r, r) gram/core -> (lower Cholesky factor of g + shift I, shift)."""
+    g = 0.5 * (g + g.T)
+    r = g.shape[0]
+    shift = _SHIFT * jnp.maximum(jnp.trace(g), 1e-30) / r
+    return jnp.linalg.cholesky(g + shift * jnp.eye(r, dtype=g.dtype)), shift
+
+
+def _pin_rows(plan: GramPlan | None, x: jnp.ndarray) -> jnp.ndarray:
+    """Row-shard an (N, r) block over the mesh (no-op without a plan or
+    on a single device) — placed inside the jits so XLA sees the layout
+    and inserts the psums."""
+    if plan is None or plan.mesh.devices.size == 1:
+        return x
+    return jax.lax.with_sharding_constraint(x, meshes.rows_flat(plan.mesh))
+
+
+def _chol_qr_once(y, plan):
+    g = jax.lax.dot_general(  # (r, r): local product + one psum
+        y, y, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    l, _ = _shifted_chol(g)
+    # y @ L^-T via a triangular solve on the SKINNY side.
+    w = jax.scipy.linalg.solve_triangular(
+        l, jnp.eye(l.shape[0], dtype=l.dtype), lower=True
+    )
+    return _pin_rows(plan, y @ w.T)
+
+
+def _orthonormalize_impl(y, plan):
+    y = _pin_rows(plan, y)
+    y = _chol_qr_once(y, plan)
+    return _chol_qr_once(y, plan)  # CholeskyQR2: second round -> ~f32 ortho
+
+
+def _nystrom_impl(y, qc, k: int, plan):
+    y = _pin_rows(plan, y)
+    qc = _pin_rows(plan, qc)
+    core = jax.lax.dot_general(  # Omega^T B Omega: local + psum
+        qc, y, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    l, shift = _shifted_chol(core)
+    # F = Y L^-T: B ~ F F^T, so eig(B) = eig(F^T F) (r x r).
+    w = jax.scipy.linalg.solve_triangular(
+        l, jnp.eye(l.shape[0], dtype=l.dtype), lower=True
+    )
+    f = _pin_rows(plan, y @ w.T)
+    g = jax.lax.dot_general(
+        f, f, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    e, s = jnp.linalg.eigh(0.5 * (g + g.T))  # ascending
+    vals = e[::-1][:k]
+    vecs = f @ (s[:, ::-1][:, :k] / jnp.sqrt(jnp.maximum(e[::-1][:k], 1e-30)))
+    # Undo the stabilizing shift (the shifted-Nystrom estimator); clamp
+    # at zero — B is PSD by construction for every sketchable metric.
+    return jnp.maximum(vals - shift, 0.0), vecs
+
+
+def _rayleigh_impl(y, q, k: int, plan):
+    y = _pin_rows(plan, y)
+    q = _pin_rows(plan, q)
+    t = jax.lax.dot_general(  # Q^T B Q: local + psum
+        q, y, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    e, s = jnp.linalg.eigh(0.5 * (t + t.T))  # ascending
+    vals = e[::-1][:k]
+    vecs = q @ s[:, ::-1][:, :k]
+    return vals, vecs
+
+
+@lru_cache(maxsize=32)
+def _orthonormalize_jit(plan: GramPlan | None):
+    repl = None if plan is None else meshes.replicated(plan.mesh)
+    kw = {} if repl is None else {
+        "in_shardings": (repl,), "out_shardings": repl,
+    }
+    return jax.jit(lambda y: _orthonormalize_impl(y, plan), **kw)
+
+
+@lru_cache(maxsize=32)
+def _nystrom_jit(plan: GramPlan | None, k: int):
+    repl = None if plan is None else meshes.replicated(plan.mesh)
+    kw = {} if repl is None else {
+        "in_shardings": (repl, repl), "out_shardings": (repl, repl),
+    }
+    return jax.jit(lambda y, qc: _nystrom_impl(y, qc, k, plan), **kw)
+
+
+@lru_cache(maxsize=32)
+def _rayleigh_jit(plan: GramPlan | None, k: int):
+    repl = None if plan is None else meshes.replicated(plan.mesh)
+    kw = {} if repl is None else {
+        "in_shardings": (repl, repl), "out_shardings": (repl, repl),
+    }
+    return jax.jit(lambda y, q: _rayleigh_impl(y, q, k, plan), **kw)
+
+
+def orthonormalize(y: jnp.ndarray, plan: GramPlan | None = None):
+    """Shifted CholeskyQR2 of an (N, r) block -> orthonormal columns
+    spanning the same space (the between-pass step of the corrected
+    rung). The output stays centered when the input is (it is a right
+    multiplication)."""
+    return _orthonormalize_jit(plan)(y)
+
+
+def nystrom_eigs(y: jnp.ndarray, qc: jnp.ndarray, k: int,
+                 plan: GramPlan | None = None):
+    """Top-k eigenpairs of the single-pass Nystrom approximation built
+    from sketch ``y = B @ omega`` and test block ``qc``. Returns
+    (vals (k,) descending >= 0, vecs (N, k) orthonormal)."""
+    return _nystrom_jit(plan, k)(y, qc)
+
+
+def rayleigh_eigs(y: jnp.ndarray, q: jnp.ndarray, k: int,
+                  plan: GramPlan | None = None):
+    """Top-k Ritz pairs from the last subspace-iteration pass
+    (``y = B q``, q orthonormal). Returns (vals (k,) descending,
+    vecs (N, k))."""
+    return _rayleigh_jit(plan, k)(y, q)

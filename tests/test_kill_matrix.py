@@ -1,8 +1,8 @@
 """Kill-resume bit-identity matrix: {streaming gram, store compaction,
-serve hot-reload} x 3 seeded kill points each, every run supervised
-(core/supervisor.py) so the kill -> restart -> resume cycle is the REAL
-production path, and every resumed output compared bit-for-bit against
-an uninterrupted run."""
+serve hot-reload, streaming sketch solve} x 3 seeded kill points each,
+every run supervised (core/supervisor.py) so the kill -> restart ->
+resume cycle is the REAL production path, and every resumed output
+compared bit-for-bit against an uninterrupted run."""
 
 import json
 import os
@@ -20,6 +20,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GRAM_KILL_POINTS = (1, 3, 5)     # ingest.block_read hit the kill lands on
 COMPACT_KILL_POINTS = (0, 1, 2)
 SERVE_KILL_POINTS = (0, 2, 4)    # serve.request hit
+SKETCH_KILL_POINTS = (1, 4, 9)   # pass 0 early, pass 0 late, pass 1
 
 
 def _env(**extra):
@@ -87,6 +88,56 @@ def test_gram_kill_resume_bit_identical(packed_store, gram_clean,
     assert "supervisor: attempt 0: crash: exit code 113" in p.stderr
     with open(out, "rb") as f:
         assert f.read() == gram_clean
+
+
+# -------------------------------------------------- streaming sketch solve
+
+
+def _sketch_cmd(store, out, ckpt):
+    return [sys.executable, "-m", "spark_examples_tpu", "pcoa",
+            "--source", "packed", "--path", store,
+            "--block-variants", "128", "--metric", "grm",
+            "--solver", "corrected", "--sketch-rank", "12",
+            "--sketch-iters", "1", "--num-pc", "3",
+            "--checkpoint-dir", ckpt, "--checkpoint-every-blocks", "2",
+            "--output-path", out]
+
+
+@pytest.fixture(scope="module")
+def sketch_clean(packed_store, tmp_path_factory):
+    store, _g = packed_store
+    d = tmp_path_factory.mktemp("sketch_clean")
+    out = str(d / "clean.tsv")
+    p = subprocess.run(_sketch_cmd(store, out, str(d / "ck")),
+                       env=_env(), capture_output=True, text=True,
+                       timeout=240)
+    assert p.returncode == 0, p.stderr[-2000:]
+    with open(out, "rb") as f:
+        return f.read()
+
+
+@pytest.mark.parametrize("kill_after", SKETCH_KILL_POINTS)
+def test_sketch_kill_resume_bit_identical(packed_store, sketch_clean,
+                                          tmp_path, kill_after):
+    """Supervised sketch-solver run (corrected rung: 2 streamed passes
+    over 8 blocks each) killed at the Nth block read — mid-pass-0, late
+    pass-0, or inside the power-iteration pass — restarts under the
+    supervisor, resumes from the checkpointed (N, r) sketch state (probe
+    seed re-derived, cursor + pass index from the manifest), and the
+    coordinate bytes equal the uninterrupted run's."""
+    store, _g = packed_store
+    out = str(tmp_path / "coords.tsv")
+    env = _env(**{
+        faults.ENV_SPECS:
+            f"ingest.block_read:kill:after={kill_after}:max=1",
+    })
+    cmd = _sketch_cmd(store, out, str(tmp_path / "ck")) + ["--supervise"]
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "supervisor: attempt 0: crash: exit code 113" in p.stderr
+    with open(out, "rb") as f:
+        assert f.read() == sketch_clean
 
 
 # ------------------------------------------------------ store compaction

@@ -185,6 +185,15 @@ def test_core_names_present():
         "serve.health",
         "serve.worker_restarts",
         "serve.breaker_open",
+        # streaming sketch solver (registered from day one — the
+        # CI/tooling satellite of the solvers PR)
+        "solver.pass",
+        "solver.solve",
+        "solver.passes",
+        "solver.rung",
+        "solver.rank",
+        "solver.state_bytes",
+        "solver.nxn_bytes_avoided",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution
