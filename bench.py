@@ -24,6 +24,13 @@ readahead pool) vs store-hit ingest throughput (headline
 delta, and a store-round-trip PCoA bit-identity check against the
 4-worker-compacted store (``configs.store``).
 
+Every run APPENDS its headline (plus git sha / argv / platform
+provenance) to the append-only ``BENCH_HISTORY.jsonl``; ``--trend``
+additionally gates the run against the trailing history with the
+noise-aware checker (tools/trend.py: per-metric direction-aware
+median/MAD bands) and exits nonzero on a regression — the mechanical
+replacement for a human diffing BENCH_r*.json by hand.
+
 The headline ``value`` is the
 **staged chip number** (cohort resident in HBM, gram + dense solve):
 it measures the framework on the chip, so it is comparable across
@@ -1447,6 +1454,35 @@ def main() -> None:
             and configs["store"]["store_hit_vs_cold_parse"] >= 3.0
             and configs["store"]["compact_deterministic_w4_vs_w1"]
         )
+    # Noise-aware trend gate (tools/trend.py): the candidate headline
+    # vs the trailing BENCH_HISTORY.jsonl window. Checked BEFORE the
+    # append so the run never gates against itself.
+    from tools import trend as trend_mod
+
+    history_path = os.path.join(REPO, trend_mod.HISTORY_FILE)
+    trend_report = None
+    if "--trend" in sys.argv:
+        # Gate against THIS backend's history only: seconds on a CPU
+        # dev box and seconds on the chip are different quantities.
+        trend_report = trend_mod.check_and_count(
+            history_path, headline, backend=jax.default_backend())
+        headline["trend_ok"] = trend_report["ok"]
+        if trend_report["regressions"]:
+            headline["trend_regressions"] = [
+                r["metric"] for r in trend_report["regressions"]]
+    # The headline is RECORDED, not just printed (every run, with git
+    # sha / config / platform provenance) — the substrate the trend
+    # checker reads exists from day one.
+    try:
+        trend_mod.append_history(history_path, headline, run_meta={
+            "argv": sys.argv[1:],
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0].device_kind),
+        })
+    except OSError as e:
+        log(f"{trend_mod.HISTORY_FILE} not appended ({e}); the run's "
+            "record survives in the stdout lines below")
+
     full = {**headline, "configs": configs}
     try:
         with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
@@ -1461,6 +1497,10 @@ def main() -> None:
     # its capture window, clipping the headline (VERDICT r5 weak #1).
     print(json.dumps(full))
     print(json.dumps(headline))
+    if trend_report is not None and not trend_report["ok"]:
+        for line in trend_mod.regression_lines(trend_report):
+            log(line)
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -240,6 +240,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "trace.jsonl (--no-trace-events keeps the "
                    "metrics.json export but skips the event timeline "
                    "for very long streams)")
+    p.add_argument("--telemetry-flush-s", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="publish live telemetry snapshots every this "
+                   "many seconds (atomic metrics.json + rolling "
+                   "live_trace.jsonl under --telemetry-dir) so the "
+                   "running job is observable without killing it; "
+                   "0 = export at exit only")
+    p.add_argument("--live-port", type=int, default=None, metavar="PORT",
+                   help="bind a live-introspection HTTP sidecar on "
+                   "this port (0 = ephemeral): GET /metrics "
+                   "(Prometheus text), /debug/telemetry (full live "
+                   "snapshot JSON), /healthz — works mid-run for "
+                   "batch jobs (gram/sketch/ingest); under "
+                   "--supervise the parent proxies it so the "
+                   "endpoint stays up across child restarts")
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler trace of the job into this "
                    "directory (view with tensorboard's profile plugin)")
@@ -258,6 +273,8 @@ def _job_from_args(args) -> JobConfig:
         telemetry=config.TelemetryConfig(
             dir=args.telemetry_dir,
             trace_events=args.trace_events,
+            flush_s=args.telemetry_flush_s,
+            live_port=args.live_port,
         ),
         ingest=IngestConfig(
             source=args.source,
@@ -485,6 +502,23 @@ def main(argv: list[str] | None = None) -> int:
                          "address (not just the quarantine ledger) and "
                          "heal whatever fails")
 
+    p_tel = sub.add_parser(
+        "telemetry",
+        help="telemetry maintenance. `telemetry stitch --path <dir>`: "
+        "merge a job's per-attempt, per-rank exports "
+        "(attempt<a>/rank<r>/trace.jsonl from supervised restarts, "
+        "rank<r>/ otherwise) into ONE Perfetto-loadable session trace "
+        "on a shared wall-clock timeline, with the supervisor's "
+        "crash/hang/stall incidents as restart markers",
+    )
+    p_tel.add_argument("verb", choices=["stitch"],
+                       help="maintenance action")
+    p_tel.add_argument("--path", required=True,
+                       help="the --telemetry-dir of the job to stitch")
+    p_tel.add_argument("--output", default=None,
+                       help="stitched trace path (default: "
+                       "<path>/stitched_trace.jsonl)")
+
     p_cov = sub.add_parser("coverage",
                            help="per-base read coverage over ranges "
                            "(the SearchReads example tier)")
@@ -505,17 +539,34 @@ def main(argv: list[str] | None = None) -> int:
         return _run_coverage(args)
     if args.command == "store":
         return _run_store_admin(args)
+    if args.command == "telemetry":
+        return _run_telemetry_admin(args)
     if getattr(args, "supervise", False):
         # The supervision layer: re-invoke this same command (flag
         # stripped) as a watched child and restart it on crash/hang/
         # stall — BEFORE any jax import, so the parent stays a light
-        # watchdog that never holds a device.
+        # watchdog that never holds a device. --live-port moves to the
+        # parent: it proxies the children's ephemeral sidecars so the
+        # scrape endpoint survives restarts; --telemetry-dir (kept on
+        # the child) tells the parent where its incident ledger goes.
         from spark_examples_tpu.core.supervisor import supervise_cli
+
+        # Same config-time knob validation the child will run — caught
+        # HERE so a bad flag (e.g. --live-port 99999, which the PARENT
+        # binds for its proxy) is a clean usage error, not a raw
+        # OverflowError from the watchdog or a doomed restart loop.
+        # Dataclass construction only: still no jax in the parent.
+        try:
+            _job_from_args(args)
+        except ValueError as e:
+            parser.error(str(e))
 
         return supervise_cli(
             list(argv) if argv is not None else sys.argv[1:],
             max_restarts=args.supervise_max_restarts,
             stall_timeout_s=args.supervise_stall_timeout,
+            live_port=getattr(args, "live_port", None),
+            telemetry_dir=getattr(args, "telemetry_dir", None),
         )
     if args.command == "pca" and args.metric != "shared-alt":
         parser.error(
@@ -581,7 +632,8 @@ def main(argv: list[str] | None = None) -> int:
             stack.callback(hb.stop)
         if job.telemetry.dir:
             telemetry.configure(dir=job.telemetry.dir,
-                                trace_events=job.telemetry.trace_events)
+                                trace_events=job.telemetry.trace_events,
+                                flush_s=job.telemetry.flush_s)
 
             def _export_telemetry():
                 d = telemetry.export()
@@ -589,6 +641,30 @@ def main(argv: list[str] | None = None) -> int:
                     print(f"telemetry -> {d}", file=sys.stderr)
 
             stack.callback(_export_telemetry)
+            # LIFO: the flusher stops (one final publish) BEFORE the
+            # full export writes the definitive trace.jsonl.
+            stack.callback(telemetry.stop_periodic_flush)
+        # Live introspection sidecar: the --live-port flag, or the
+        # environment when a supervisor parent armed this child with
+        # an ephemeral port + port file for its proxy.
+        from spark_examples_tpu.core.live import maybe_start_live
+
+        live_server = maybe_start_live(port=job.telemetry.live_port)
+        if live_server is not None:
+            stack.callback(live_server.shutdown)
+            if job.telemetry.live_port is not None:
+                # Only the explicitly flagged sidecar announces itself:
+                # an env-armed one (a supervised child) binds a private
+                # ephemeral port that dies on the next restart — the
+                # parent already printed ITS proxy endpoint, and naming
+                # the child's here would steer the operator to the
+                # wrong socket.
+                print(
+                    f"live telemetry on http://{live_server.host}:"
+                    f"{live_server.port} (GET /metrics, "
+                    "/debug/telemetry, /healthz)",
+                    file=sys.stderr,
+                )
         try:
             return _dispatch(args, parser, job, J, build_source)
         except BrokenPipeError:
@@ -929,6 +1005,35 @@ def _run_serve(args, parser, job, build_source) -> int:
                 http.shutdown()
     finally:
         server.close()
+    return 0
+
+
+def _run_telemetry_admin(args) -> int:
+    """The ``telemetry`` maintenance subcommand (currently: ``stitch``).
+    Prints the stitch report as JSON; exit 0 iff something stitched."""
+    from spark_examples_tpu.core.stitch import StitchError, stitch
+
+    try:
+        report = stitch(args.path, output=args.output)
+    except StitchError as e:
+        print(f"telemetry stitch: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(report, sort_keys=True))
+    if report["mixed_run_ids"]:
+        print(
+            f"telemetry stitch: WARNING — {len(report['run_ids'])} "
+            "distinct run_ids merged; this directory holds exports "
+            "from more than one logical job",
+            file=sys.stderr,
+        )
+    print(
+        f"telemetry stitch: {report['events']} events from "
+        f"{len(report['attempts'])} attempt(s) x "
+        f"{len(report['ranks'])} rank(s), "
+        f"{report['restart_markers']} restart marker(s) -> "
+        f"{report['output']} (open in https://ui.perfetto.dev)",
+        file=sys.stderr,
+    )
     return 0
 
 

@@ -334,10 +334,45 @@ class TelemetryConfig:
     but skips buffering per-block span events (metrics-only mode for
     very long streams). Metrics *collection* is always on regardless —
     this only controls export and event buffering.
+
+    The live plane (this PR): ``flush_s > 0`` starts the periodic
+    in-process snapshot publisher — ``metrics.json`` plus a rolling
+    ``live_trace.jsonl`` ring atomically republished every ``flush_s``
+    seconds under ``dir``, so a running job is observable without
+    killing it. ``live_port`` (``--live-port``; 0 = ephemeral) binds
+    the stdlib HTTP sidecar (core/live.py) serving ``/metrics``
+    (Prometheus text), ``/debug/telemetry`` (the full live snapshot
+    JSON), and ``/healthz`` — the scrape surface for *batch* jobs;
+    under ``--supervise`` the parent proxies it across restarts.
     """
 
     dir: str | None = None
     trace_events: bool = True
+    flush_s: float = 0.0  # 0 = export at exit only
+    live_port: int | None = None  # None = no sidecar; 0 = ephemeral
+
+    def __post_init__(self):
+        if not (isinstance(self.flush_s, (int, float))
+                and 0.0 <= self.flush_s <= 86400.0):
+            raise ValueError(
+                f"bad telemetry config: --telemetry-flush-s="
+                f"{self.flush_s!r} — expected seconds in [0, 86400] "
+                "(0 disables the periodic flusher)"
+            )
+        if self.flush_s and not self.dir:
+            raise ValueError(
+                "bad telemetry config: --telemetry-flush-s needs "
+                "--telemetry-dir (the periodic flusher publishes "
+                "snapshots under the export directory)"
+            )
+        if self.live_port is not None and not (
+                isinstance(self.live_port, int)
+                and 0 <= self.live_port <= 65535):
+            raise ValueError(
+                f"bad telemetry config: --live-port={self.live_port!r} "
+                "— expected a TCP port in [0, 65535] (0 binds an "
+                "ephemeral port)"
+            )
 
 
 @dataclass

@@ -67,6 +67,15 @@ Sites instrumented in production code:
                             the hang and restart; ``io_error`` fails
                             one write (tolerated, warned, never fatal
                             to the job thread)
+``telemetry.flush``         per periodic live-telemetry flush
+                            (core/telemetry.py PeriodicFlusher), fired
+                            with the metrics.json path before the
+                            atomic write — ``io_error`` fails one
+                            flush (tolerated, warned, counted),
+                            ``kill`` mid-flush must leave the
+                            last-good snapshot readable (tmp+rename),
+                            ``truncate`` corrupts the current file
+                            until the flush's own rename restores it
 ==========================  ====================================================
 
 Env grammar (``;``-separated specs, ``:``-separated fields)::
@@ -108,6 +117,7 @@ SITES = (
     "store.readahead.decode",
     "prefetch.transfer_wait",
     "supervisor.heartbeat",
+    "telemetry.flush",
 )
 
 # Distinctive exit code for the "kill" kind so tests can tell an injected

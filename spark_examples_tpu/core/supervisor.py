@@ -50,6 +50,7 @@ import subprocess
 import sys
 import threading
 import time
+import uuid
 import warnings
 from dataclasses import dataclass, field
 
@@ -65,16 +66,27 @@ DEFAULT_INTERVAL_S = 0.5
 # Child side: the heartbeat.
 
 
+# Name prefixes that advance WITHOUT the job making progress and must
+# therefore never feed the progress token: the supervisor's own
+# heartbeat counter, the live plane's flusher/scrape counters (a
+# periodic flush or an operator polling /metrics every few seconds
+# would otherwise make a stalled job look alive forever), and the
+# telemetry layer's own bookkeeping — once the trace buffer fills,
+# every flusher span bumps telemetry.dropped_events on pure
+# wall-clock, which would defeat the stall detector on exactly the
+# long runs it exists for.
+_TOKEN_EXCLUDE = ("supervisor.", "live.", "telemetry.")
+
+
 def _token_from(snap: dict) -> float:
     """The progress token: the sum of every telemetry counter plus
-    every histogram's sample count — EXCLUDING the supervisor's own
-    names, whose heartbeat counter would otherwise advance the token
-    on every beat and make a stalled job look alive forever."""
+    every histogram's sample count — EXCLUDING the self-reporting
+    names above, which advance on wall-clock, not work."""
     total = sum(v for k, v in snap["counters"].items()
-                if not k.startswith("supervisor."))
+                if not k.startswith(_TOKEN_EXCLUDE))
     total += sum(snap["phases"].values())
     total += sum(h.get("count", 0) for k, h in snap["histograms"].items()
-                 if not k.startswith("supervisor."))
+                 if not k.startswith(_TOKEN_EXCLUDE))
     return float(total)
 
 
@@ -96,6 +108,9 @@ def heartbeat_payload() -> dict:
     return {
         "t": time.time(),
         "pid": os.getpid(),
+        # run_id/attempt/rank: the same stitch identity every trace
+        # event carries, so a heartbeat is attributable to its attempt.
+        **telemetry.identity(),
         "progress": float(token),
         "blocks": hists.get("gram.block", {}).get("count", 0),
         "block_p95_s": hists.get("gram.block", {}).get("p95", 0.0),
@@ -212,6 +227,9 @@ class SupervisedRun:
     restarts: int = 0
     watchdog_kills: int = 0
     incidents: list[str] = field(default_factory=list)
+    # The parent proxy's scrape URL when --live-port was asked for
+    # (stays answering across child restarts); None otherwise.
+    live_endpoint: str | None = None
 
     @property
     def ok(self) -> bool:
@@ -246,7 +264,10 @@ def supervise(cmd: list[str], policy: SupervisorPolicy = SupervisorPolicy(),
               env: dict | None = None, cwd: str | None = None,
               heartbeat_path: str | None = None,
               strip_faults_on_restart: bool = True,
-              stdout=None, stderr=None) -> SupervisedRun:
+              stdout=None, stderr=None,
+              live_port: int | None = None,
+              live_host: str = "127.0.0.1",
+              telemetry_dir: str | None = None) -> SupervisedRun:
     """Run ``cmd`` as a supervised child until it exits 0, restarting
     on crash, hang, or stall up to ``policy.max_restarts`` times.
 
@@ -256,6 +277,17 @@ def supervise(cmd: list[str], policy: SupervisorPolicy = SupervisorPolicy(),
     children run with the fault-injection variables stripped by default
     (an injected schedule is one incident — see module docstring).
 
+    Every child shares one ``run_id`` and gets its attempt ordinal
+    (:data:`telemetry.ENV_RUN_ID` / :data:`telemetry.ENV_ATTEMPT`), so
+    per-attempt telemetry exports stitch back into one session trace
+    (core/stitch.py). With ``telemetry_dir`` set, the parent writes its
+    incident ledger (``supervisor.json``) there — the restart markers
+    of the stitched trace. With ``live_port`` set, the parent runs a
+    :class:`~spark_examples_tpu.core.live.SupervisorLiveProxy` on that
+    port: children bind ephemeral ``--live-port`` sidecars (armed via
+    the environment) and the parent's endpoint stays scrapeable across
+    restarts, serving the last-good snapshot while a child is down.
+
     Returns the final :class:`SupervisedRun`; ``returncode`` is the
     last child's exit code (0 on success, the last failure when the
     restart budget ran out).
@@ -263,50 +295,129 @@ def supervise(cmd: list[str], policy: SupervisorPolicy = SupervisorPolicy(),
     base_env = dict(os.environ if env is None else env)
     hb_path = heartbeat_path or os.path.join(
         base_env.get("TMPDIR", "/tmp"), f"supervisor-{os.getpid()}.hb")
+    rid = base_env.get(telemetry.ENV_RUN_ID, "").strip() \
+        or uuid.uuid4().hex[:12]
     run = SupervisedRun(returncode=1)
-    attempt = 0
-    while True:
-        child_env = dict(base_env)
-        child_env[ENV_HEARTBEAT] = hb_path
-        if attempt > 0 and strip_faults_on_restart:
-            child_env.pop(faults.ENV_SPECS, None)
-            child_env.pop(faults.ENV_SEED, None)
+    ledger: list[dict] = []
+    state = {"attempt": 0}
+
+    def _write_ledger(final: bool = False) -> None:
+        # Best-effort, atomic, after every incident — a parent that
+        # dies mid-job still leaves the incidents recorded so far.
+        if not telemetry_dir:
+            return
         try:
-            os.remove(hb_path)  # stale liveness must not carry over
+            os.makedirs(telemetry_dir, exist_ok=True)
+            telemetry._atomic_write(
+                os.path.join(telemetry_dir, "supervisor.json"),
+                json.dumps({
+                    "run_id": rid,
+                    "incidents": ledger,
+                    "restarts": run.restarts,
+                    "watchdog_kills": run.watchdog_kills,
+                    "final_returncode": run.returncode if final else None,
+                    "done": final,
+                }, indent=1))
         except OSError:
             pass
-        spawned = time.time()
-        proc = subprocess.Popen(cmd, env=child_env, cwd=cwd,
-                                stdout=stdout, stderr=stderr)
-        incident = _watch(proc, hb_path, policy, spawned)
-        if incident is None:  # clean exit
-            run.returncode = 0
-            return run
-        kind, detail, rc = incident
-        run.returncode = rc
-        run.incidents.append(f"attempt {attempt}: {kind}: {detail}")
-        if kind in ("hang", "stall"):
-            run.watchdog_kills += 1
-            telemetry.count("supervisor.stalls")
-        if kind == "crash" and rc in policy.non_retryable_exits:
-            run.incidents.append(
-                f"exit code {rc} is non-retryable (a usage/config "
-                "error fails identically every attempt) — not "
-                "restarting")
-            return run
-        if attempt >= policy.max_restarts:
-            run.incidents.append(
-                f"restart budget ({policy.max_restarts}) exhausted")
-            return run
-        attempt += 1
-        run.restarts += 1
-        telemetry.count("supervisor.restarts")
-        warnings.warn(
-            f"supervisor: child {kind} ({detail}); restarting "
-            f"({policy.max_restarts - attempt + 1} restarts left) — "
-            "resuming from the latest checkpoint",
-            RuntimeWarning, stacklevel=2,
+
+    proxy = None
+    port_file = None
+    if live_port is not None:
+        from spark_examples_tpu.core import live as live_mod
+
+        port_file = hb_path + ".liveport"
+
+        def _proxy_state() -> dict:
+            return {"run_id": rid, "attempt": state["attempt"],
+                    "restarts": run.restarts,
+                    "watchdog_kills": run.watchdog_kills}
+
+        proxy = live_mod.SupervisorLiveProxy(
+            live_host, live_port, port_file, _proxy_state,
+            announce_path=base_env.get(live_mod.ENV_ANNOUNCE, "").strip()
+            or None,
+        ).serve_in_thread()
+        run.live_endpoint = f"http://{proxy.host}:{proxy.port}"
+        # Announced HERE, before the first child spawns: this (not the
+        # children's private ephemeral sidecars) is the endpoint that
+        # survives restarts, and supervise() blocks until the job is
+        # over — a caller printing run.live_endpoint afterwards would
+        # tell the operator about a socket that is already closed.
+        print(
+            f"supervisor: live telemetry on {run.live_endpoint} "
+            "(GET /metrics, /debug/telemetry, /healthz; proxied to "
+            "the supervised child, stays up across restarts)",
+            file=sys.stderr,
         )
+
+    attempt = 0
+    try:
+        while True:
+            state["attempt"] = attempt
+            child_env = dict(base_env)
+            child_env[ENV_HEARTBEAT] = hb_path
+            child_env[telemetry.ENV_RUN_ID] = rid
+            child_env[telemetry.ENV_ATTEMPT] = str(attempt)
+            if attempt > 0 and strip_faults_on_restart:
+                child_env.pop(faults.ENV_SPECS, None)
+                child_env.pop(faults.ENV_SEED, None)
+            stale = [hb_path]  # stale liveness must not carry over
+            if port_file is not None:
+                from spark_examples_tpu.core import live as live_mod
+
+                child_env[live_mod.ENV_PORT] = "0"
+                child_env[live_mod.ENV_PORT_FILE] = port_file
+                # The announce file names the PARENT's endpoint; a
+                # child re-announcing its private port would point
+                # scrapers at a socket that dies on the next restart.
+                child_env.pop(live_mod.ENV_ANNOUNCE, None)
+                stale.append(port_file)
+            for path in stale:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            spawned = time.time()
+            proc = subprocess.Popen(cmd, env=child_env, cwd=cwd,
+                                    stdout=stdout, stderr=stderr)
+            incident = _watch(proc, hb_path, policy, spawned)
+            if incident is None:  # clean exit
+                run.returncode = 0
+                return run
+            kind, detail, rc = incident
+            run.returncode = rc
+            run.incidents.append(f"attempt {attempt}: {kind}: {detail}")
+            ledger.append({"attempt": attempt, "kind": kind,
+                           "detail": detail, "returncode": rc,
+                           "t_unix": time.time()})
+            if kind in ("hang", "stall"):
+                run.watchdog_kills += 1
+                telemetry.count("supervisor.stalls")
+            if kind == "crash" and rc in policy.non_retryable_exits:
+                run.incidents.append(
+                    f"exit code {rc} is non-retryable (a usage/config "
+                    "error fails identically every attempt) — not "
+                    "restarting")
+                return run
+            if attempt >= policy.max_restarts:
+                run.incidents.append(
+                    f"restart budget ({policy.max_restarts}) exhausted")
+                return run
+            attempt += 1
+            run.restarts += 1
+            _write_ledger()
+            telemetry.count("supervisor.restarts")
+            warnings.warn(
+                f"supervisor: child {kind} ({detail}); restarting "
+                f"({policy.max_restarts - attempt + 1} restarts left) — "
+                "resuming from the latest checkpoint",
+                RuntimeWarning, stacklevel=2,
+            )
+    finally:
+        _write_ledger(final=True)
+        if proxy is not None:
+            proxy.shutdown()
 
 
 def _watch(proc: subprocess.Popen, hb_path: str,
@@ -381,6 +492,11 @@ def _watch(proc: subprocess.Popen, hb_path: str,
 
 SUPERVISE_FLAGS = ("--supervise", "--supervise-max-restarts",
                    "--supervise-stall-timeout")
+# Value-taking flags the PARENT owns: stripped from the child argv.
+# --live-port binds the parent's proxy; children get ephemeral sidecar
+# ports through the environment instead (two processes cannot share
+# the one public port).
+_VALUE_FLAGS = SUPERVISE_FLAGS[1:] + ("--live-port",)
 
 
 def strip_supervise_flags(argv: list[str]) -> list[str]:
@@ -394,7 +510,7 @@ def strip_supervise_flags(argv: list[str]) -> list[str]:
             continue
         if tok == "--supervise":
             continue
-        if tok.split("=", 1)[0] in SUPERVISE_FLAGS[1:]:
+        if tok.split("=", 1)[0] in _VALUE_FLAGS:
             skip = "=" not in tok
             continue
         out.append(tok)
@@ -402,14 +518,18 @@ def strip_supervise_flags(argv: list[str]) -> list[str]:
 
 
 def supervise_cli(argv: list[str], max_restarts: int,
-                  stall_timeout_s: float) -> int:
+                  stall_timeout_s: float,
+                  live_port: int | None = None,
+                  live_host: str = "127.0.0.1",
+                  telemetry_dir: str | None = None) -> int:
     """The ``--supervise`` entrypoint: re-invoke this CLI (flag
     stripped) under the watchdog; exit with the final child's code."""
     policy = SupervisorPolicy(max_restarts=max_restarts,
                               stall_timeout_s=stall_timeout_s)
     cmd = [sys.executable, "-m", "spark_examples_tpu",
            *strip_supervise_flags(argv)]
-    run = supervise(cmd, policy=policy)
+    run = supervise(cmd, policy=policy, live_port=live_port,
+                    live_host=live_host, telemetry_dir=telemetry_dir)
     for line in run.incidents:
         print(f"supervisor: {line}", file=sys.stderr)
     if run.restarts:

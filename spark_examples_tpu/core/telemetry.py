@@ -35,6 +35,27 @@ via :func:`configure` (``--telemetry-dir`` / ``--trace-events``), capped
 at :data:`MAX_EVENTS` with an overflow counter rather than unbounded
 growth.
 
+**The live plane** (this PR's tentpole): telemetry no longer
+materializes only at process exit. :func:`live_snapshot` is the
+read-side API (full metrics snapshot + a rolling ring of recent trace
+events + job identity), and :func:`configure` with ``flush_s > 0``
+starts a background :class:`PeriodicFlusher` that atomically
+(tmp+rename — a mid-write kill leaves the last-good snapshot readable;
+the ``telemetry.flush`` fault site proves it) republishes
+``metrics.json`` plus ``live_trace.jsonl`` (the recent-event ring)
+every K seconds, so an operator, an autoscaler, or the supervisor's
+stall detector can observe a running job without killing it. The HTTP
+surfaces over this API live in :mod:`core.live`.
+
+**Job identity for restart stitching**: every exported trace event and
+heartbeat carries a stable ``run_id`` plus ``attempt``/``rank``
+metadata (:func:`run_id` / :func:`attempt`; the supervisor parent pins
+both through the environment, so all attempts of one supervised job
+share a run_id and each attempt exports into its own
+``attempt<k>/rank<r>/`` directory). ``telemetry stitch`` (core/
+stitch.py) merges those per-attempt, per-rank exports into one
+Perfetto-loadable session trace annotated with restart markers.
+
 Every name used at an instrumentation site must be declared in
 :data:`NAMES` (families like ``phase.*`` cover dynamic suffixes);
 ``tests/test_telemetry_names.py`` lints call sites against the registry
@@ -51,6 +72,7 @@ import math
 import os
 import threading
 import time
+import uuid
 import warnings
 from contextlib import contextmanager
 
@@ -129,6 +151,13 @@ NAMES: dict[str, tuple[str, str]] = {
         "the sketch solver's terminal solve: Nystrom eigenpairs "
         "(single-pass rung) or Rayleigh Ritz pairs (corrected) from the "
         "(N, rank) sketch state — rank-sized math, never an N x N eigh",
+    ),
+    "live.flush": (
+        "span",
+        "one periodic live-telemetry flush: the telemetry.flush fault "
+        "site + atomic metrics.json rewrite + rolling live_trace.jsonl "
+        "ring rewrite (tmp+rename both, so a kill mid-flush leaves the "
+        "last-good snapshot readable)",
     ),
     # -- instant events ---------------------------------------------------
     "fault": ("event", "a fault-injection spec fired (args: site, kind)"),
@@ -301,6 +330,51 @@ NAMES: dict[str, tuple[str, str]] = {
         "store-read circuit-breaker trips in the serve panel path: "
         "repeated staging failures opened the breaker and the server "
         "entered cached-panel-only mode (still serving, degraded)",
+    ),
+    "live.flushes": (
+        "counter",
+        "periodic live-telemetry snapshots published by the background "
+        "flusher (atomic metrics.json + rolling live_trace.jsonl every "
+        "flush_s seconds — the mid-run observability the exit-time "
+        "export cannot provide)",
+    ),
+    "live.flush_errors": (
+        "counter",
+        "periodic flushes that failed (unwritable dir, full disk, "
+        "injected telemetry.flush fault) — warned once and absorbed; "
+        "the flusher, like the heartbeat, must never be able to kill "
+        "the job it reports on",
+    ),
+    "live.requests": (
+        "counter",
+        "live-introspection HTTP requests answered by this process "
+        "(/metrics, /debug/telemetry, /healthz on the --live-port "
+        "sidecar or the serve front)",
+    ),
+    "live.proxy_requests": (
+        "counter",
+        "scrapes answered by a supervisor parent's live proxy on "
+        "behalf of its supervised child (the endpoint that stays up "
+        "across child restarts)",
+    ),
+    "live.proxy_stale": (
+        "counter",
+        "proxy answers served from the last-good cached child "
+        "snapshot because the child was down (mid-restart) or "
+        "unreachable — the scrape succeeds, marked stale, instead of "
+        "erroring during the exact window an operator most wants data",
+    ),
+    "trend.metrics_checked": (
+        "counter",
+        "headline metrics the noise-aware trend checker (tools/"
+        "trend.py) evaluated against the BENCH_HISTORY.jsonl "
+        "median/MAD band in this process",
+    ),
+    "trend.regressions": (
+        "counter",
+        "headline metrics the trend checker flagged as regressed "
+        "(worse than the direction-aware noise band) — bench --trend "
+        "exits nonzero when this is nonzero",
     ),
     # -- gauges -----------------------------------------------------------
     "prefetch.queue_depth": (
@@ -528,6 +602,45 @@ _events: list[dict] = []
 _dir: str | None = None
 _trace = False
 _warned_names: set[str] = set()
+_flusher: "PeriodicFlusher | None" = None
+
+# Job identity for restart/rank stitching (core/stitch.py): a stable
+# run_id shared by every attempt of one logical job, and the attempt
+# ordinal. The supervisor parent pins both through the environment so
+# a restarted child keeps the run_id and bumps the attempt; an
+# unsupervised run mints its own run_id (attempt 0).
+ENV_RUN_ID = "SPARK_EXAMPLES_TPU_RUN_ID"
+ENV_ATTEMPT = "SPARK_EXAMPLES_TPU_ATTEMPT"
+_run_id: str | None = None
+
+
+def run_id() -> str:
+    """The stable job id stamped into every exported trace event,
+    metrics meta, and heartbeat: the env value when supervised (the
+    parent mints one per supervised lifetime), else a fresh token.
+    Minted under the module lock — the flusher thread and a sidecar
+    scrape can race the first call, and two minted tokens would make
+    one job stitch as two."""
+    global _run_id
+    with _lock:
+        if _run_id is None:
+            _run_id = (os.environ.get(ENV_RUN_ID, "").strip()
+                       or uuid.uuid4().hex[:12])
+        return _run_id
+
+
+def attempt() -> int:
+    """This process's attempt ordinal (0 unsupervised / first child)."""
+    try:
+        return int(os.environ.get(ENV_ATTEMPT, "0") or 0)
+    except ValueError:
+        return 0
+
+
+def identity() -> dict:
+    """{run_id, attempt, rank} — the stitch keys, in one place."""
+    rank, _ = _rank()
+    return {"run_id": run_id(), "attempt": attempt(), "rank": rank}
 
 
 def _check_name(name: str) -> None:
@@ -549,19 +662,29 @@ def _check_name(name: str) -> None:
     )
 
 
-def configure(dir: str | None = None, trace_events: bool = True) -> None:
+def configure(dir: str | None = None, trace_events: bool = True,
+              flush_s: float = 0.0) -> None:
     """Enable export (and optionally span trace events) process-wide.
 
     Metrics are always collected; this sets where :func:`export` writes
     and whether spans buffer Chrome trace events (``trace_events=False``
     keeps ``metrics.json`` but writes an events-free ``trace.jsonl``).
 
+    ``flush_s > 0`` additionally starts the :class:`PeriodicFlusher`:
+    every ``flush_s`` seconds the current metrics snapshot and a
+    rolling ring of recent trace events are atomically republished
+    under the export directory, so the job is observable *while it
+    runs* (``live_snapshot()`` is the in-process read side; the
+    ``--live-port`` sidecar and the serve front's ``/metrics`` read
+    the registry directly and work with or without the flusher).
+
     Configuring a directory also installs the crash flush (once per
     process): an ``atexit`` hook and a SIGTERM handler that export
     whatever has been collected, so a run that dies mid-flight — an
     unhandled exception, an orchestrator's TERM — still leaves its
     trace and metrics behind. (SIGKILL / ``os._exit`` cannot be caught;
-    the supervised-run story covers those via checkpoints instead.)
+    the periodic flusher's last-good snapshot and the supervised-run
+    checkpoints cover those.)
     """
     global _dir, _trace
     with _lock:
@@ -569,6 +692,10 @@ def configure(dir: str | None = None, trace_events: bool = True) -> None:
         _trace = bool(trace_events) and dir is not None
     if dir is not None:
         _install_crash_flush()
+    if flush_s and flush_s > 0 and dir is not None:
+        start_periodic_flush(flush_s, dir=dir)
+    else:
+        stop_periodic_flush()
 
 
 _atexit_installed = False
@@ -861,13 +988,36 @@ def digest() -> dict:
 # Export.
 
 
+_rank_cache: tuple[int, int] | None = None
+
+
 def _rank() -> tuple[int, int]:
     """(process_index, process_count) — lazily, so importing this module
-    never initializes a jax backend (test bootstrap order matters)."""
+    never initializes a jax backend (test bootstrap order matters).
+
+    A process that has not imported jax at all is rank 0 of 1 and must
+    stay that way WITHOUT importing it: the periodic flusher and the
+    live HTTP surfaces call this from background threads, and paying
+    a full backend/plugin discovery (~hundreds of ms on CPU, seconds
+    on a TPU host) inside the first flush would delay the first
+    published snapshot past the lifetime of a short or quickly-killed
+    process — exactly the process whose last snapshot matters most.
+    Once jax is imported the resolved rank is cached (post-init
+    process_index is cheap, but the first call may initialize the
+    backend; pay that once)."""
+    global _rank_cache
+    if _rank_cache is not None:
+        return _rank_cache
+    import sys as _sys
+
+    if "jax" not in _sys.modules:
+        # Not cached: jax (and a real multihost rank) may arrive later.
+        return 0, 1
     try:
         import jax
 
-        return jax.process_index(), jax.process_count()
+        _rank_cache = (jax.process_index(), jax.process_count())
+        return _rank_cache
     except Exception:
         return 0, 1
 
@@ -885,6 +1035,190 @@ def metrics_snapshot() -> dict:
         "histograms": hists,
         "derived": derive_throughputs(phases, counters),
     }
+
+
+# ---------------------------------------------------------------------------
+# Live plane: read-side snapshot API + the periodic publisher.
+
+RECENT_EVENTS = 256  # rolling ring size the live surfaces expose
+
+
+def recent_events(n: int = RECENT_EVENTS) -> list[dict]:
+    """The newest ``n`` buffered trace events (empty when tracing is
+    off) — the rolling ring the live surfaces expose; the full buffer
+    still lands in ``trace.jsonl`` at export.
+
+    The flusher's own ``live.flush`` spans are excluded: during a quiet
+    or stalled stretch they would otherwise displace the job events the
+    ring exists to preserve — the killed-attempt stitch fallback needs
+    what the JOB was doing when it died, not the flusher's heartbeat.
+    (They still land in the full export, where they belong.)"""
+    if n <= 0:
+        return []
+    out: list[dict] = []
+    with _lock:
+        for ev in reversed(_events):
+            if ev.get("name") == "live.flush":
+                continue
+            out.append(dict(ev))
+            if len(out) >= n:
+                break
+    out.reverse()
+    return out
+
+
+def live_snapshot(recent: int = RECENT_EVENTS) -> dict:
+    """The in-flight introspection payload: the full metrics snapshot,
+    a rolling ring of recent trace events, and the job identity /
+    uptime an operator needs to interpret them. This is what
+    ``/debug/telemetry`` (core/live.py) serves, and what in-process
+    callers (the supervisor's heartbeat, tests) read without waiting
+    for process exit."""
+    snap = metrics_snapshot()
+    snap["recent_events"] = recent_events(recent)
+    snap["meta"] = _meta(len(snap["recent_events"]))
+    return snap
+
+
+def _meta(events_n: int) -> dict:
+    rank, n_proc = _rank()
+    now_unix, now_perf = time.time(), time.perf_counter()
+    return {
+        "rank": rank,
+        "process_count": n_proc,
+        "run_id": run_id(),
+        "attempt": attempt(),
+        "trace_events": events_n,
+        "wrote_unix_s": now_unix,
+        # Wall-clock at trace ts=0 — what lets the stitcher place this
+        # attempt's perf_counter-relative events on one global timeline
+        # next to every other attempt's.
+        "epoch_unix_s": now_unix - (now_perf - _T0),
+        "uptime_s": now_perf - _T0,
+    }
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """tmp + fsync-free rename: a reader (or a kill) mid-write sees
+    either the previous complete file or the new complete file, never
+    a torn one — the property the telemetry.flush chaos site checks."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def _atomic_write_lines(path: str, lines) -> None:
+    """Same atomicity, streaming: lines are written to the tmp file as
+    they are produced, so a full-buffer trace export (hundreds of MB at
+    MAX_EVENTS) never holds a second joined copy in memory — the
+    crash-flush moment is exactly when the process can least afford a
+    transient 2x spike."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        for line in lines:
+            f.write(line)
+            f.write("\n")
+    os.replace(tmp, path)
+
+
+def _rank_dir(base: str) -> str:
+    """Where this process exports: ``<base>/rank<r>`` normally,
+    ``<base>/attempt<a>/rank<r>`` under supervision — each restart
+    keeps its predecessor's trace instead of overwriting it, which is
+    what makes restart stitching possible at all."""
+    rank, _ = _rank()
+    if os.environ.get(ENV_ATTEMPT, "").strip():
+        return os.path.join(base, f"attempt{attempt()}", f"rank{rank}")
+    return os.path.join(base, f"rank{rank}")
+
+
+class PeriodicFlusher:
+    """Daemon thread atomically republishing ``metrics.json`` plus a
+    rolling ``live_trace.jsonl`` ring every ``interval_s`` — the
+    in-process snapshot publisher. A failed flush warns once and keeps
+    going (``live.flush_errors``); the ``telemetry.flush`` fault site
+    fires inside each flush so the chaos harness can fail, stall, or
+    kill it deterministically (a mid-write kill must leave the
+    last-good snapshot readable — guaranteed by tmp+rename)."""
+
+    def __init__(self, base: str, interval_s: float):
+        self.base = base
+        self.interval_s = max(0.01, float(interval_s))
+        self._stop = threading.Event()
+        self._warned = False
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "PeriodicFlusher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="telemetry-flusher", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+        self.flush()  # final publish so stop() never loses the tail
+
+    def flush(self) -> None:
+        from spark_examples_tpu.core import faults  # circular at module load
+
+        d = _rank_dir(self.base)
+        try:
+            with span("live.flush", cat="live"):
+                os.makedirs(d, exist_ok=True)
+                metrics_path = os.path.join(d, "metrics.json")
+                faults.fire("telemetry.flush", path=metrics_path)
+                snap = metrics_snapshot()
+                snap["meta"] = _meta(len(_events))
+                _atomic_write(metrics_path,
+                              json.dumps(snap, indent=1, sort_keys=True,
+                                         default=str))
+                rank = snap["meta"]["rank"]
+                _atomic_write_lines(
+                    os.path.join(d, "live_trace.jsonl"),
+                    (json.dumps({**ev, "pid": rank}, default=str)
+                     for ev in recent_events()))
+            count("live.flushes")
+        except BaseException as e:
+            count("live.flush_errors")
+            if not self._warned:
+                self._warned = True
+                warnings.warn(
+                    f"periodic telemetry flush to {d!r} failed ({e!r}) — "
+                    "the job continues; live snapshots may be stale "
+                    "until writes recover",
+                    RuntimeWarning, stacklevel=2,
+                )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def start_periodic_flush(interval_s: float,
+                         dir: str | None = None) -> PeriodicFlusher | None:
+    """Start (or retarget) the module's periodic flusher. Returns it,
+    or None when no directory is configured."""
+    global _flusher
+    base = dir or _dir
+    if not base:
+        return None
+    stop_periodic_flush()
+    _flusher = PeriodicFlusher(base, interval_s).start()
+    return _flusher
+
+
+def stop_periodic_flush() -> None:
+    """Stop the periodic flusher (one final flush included)."""
+    global _flusher
+    f = _flusher
+    _flusher = None
+    if f is not None:
+        f.stop()
 
 
 def export(dir: str | None = None) -> str | None:
@@ -911,30 +1245,45 @@ def export(dir: str | None = None) -> str | None:
 
 def _export(base: str) -> str:
     rank, n_proc = _rank()
-    d = os.path.join(base, f"rank{rank}")
+    d = _rank_dir(base)
     os.makedirs(d, exist_ok=True)
+    rid, att = run_id(), attempt()
 
     with _lock:
         events = sorted(_events, key=lambda e: (e["ts"], -e.get("dur", 0.0)))
-    with open(os.path.join(d, "trace.jsonl"), "w") as f:
-        meta = {"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
-                "ts": 0, "args": {"name": f"rank {rank}"}}
-        f.write(json.dumps(meta) + "\n")
+    track = f"rank {rank}" if not att else f"attempt {att} rank {rank}"
+
+    def _trace_lines():
+        yield json.dumps({"name": "process_name", "ph": "M", "pid": rank,
+                          "tid": 0, "ts": 0, "args": {"name": track}})
         for ev in events:
             # default=str: a site passing e.g. a numpy scalar attr must
-            # degrade to a stringified arg, not kill the export.
-            f.write(json.dumps({**ev, "pid": rank}, default=str) + "\n")
+            # degrade to a stringified arg, not kill the export. Every
+            # event carries the stitch identity (run_id/attempt; pid is
+            # the rank track) so a merged multi-attempt trace stays
+            # attributable event-by-event.
+            yield json.dumps(
+                {**ev, "pid": rank,
+                 "args": {**ev.get("args", {}), "run_id": rid,
+                          "attempt": att}},
+                default=str)
+
+    # Atomic (tmp+rename) like the periodic flusher's writes: the
+    # exit-time export and a racing periodic flush must each leave a
+    # complete file, whoever lands last — streamed, so the full trace
+    # is never duplicated in memory.
+    _atomic_write_lines(os.path.join(d, "trace.jsonl"), _trace_lines())
 
     snap = metrics_snapshot()
-    snap["meta"] = {"rank": rank, "process_count": n_proc,
-                    "trace_events": len(events),
-                    "wrote_unix_s": time.time()}
-    with open(os.path.join(d, "metrics.json"), "w") as f:
-        json.dump(snap, f, indent=1, sort_keys=True, default=str)
+    snap["meta"] = _meta(len(events))
+    _atomic_write(os.path.join(d, "metrics.json"),
+                  json.dumps(snap, indent=1, sort_keys=True, default=str))
 
     if rank == 0:
         try:
-            _write_summary(base, n_proc)
+            # Under supervision the rank dirs live in the attempt dir;
+            # the summary belongs next to them either way.
+            _write_summary(os.path.dirname(d), n_proc)
         except OSError as e:  # summary is a convenience, never a failure
             warnings.warn(f"telemetry summary not written: {e}",
                           RuntimeWarning, stacklevel=2)

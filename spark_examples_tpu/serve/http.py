@@ -9,8 +9,17 @@ requests — HTTP concurrency IS the micro-batch source. Endpoints:
   Errors map onto status codes the envelope semantics imply: 429
   overloaded (shed), 503 draining, 504 deadline, 400 malformed.
 - ``GET /healthz`` — liveness + in-flight/backlog counts.
-- ``GET /stats`` — the server's request accounting + the compact
-  ``serve.*`` latency digest.
+- ``GET /stats`` — the coherent operator payload
+  (:meth:`ProjectionServer.stats_payload`): request accounting,
+  latency digest, the full health-machine view (status, breaker
+  snapshot, worker restarts), and the staged panel's store-cache
+  accounting.
+- ``GET /metrics`` — the live telemetry registry as Prometheus
+  exposition text (core/live.py — the same renderer the ``--live-port``
+  batch sidecar uses, so serving and batch jobs scrape identically).
+- ``GET /debug/telemetry`` — the full ``telemetry.live_snapshot()``
+  JSON: every counter/gauge/histogram plus a rolling ring of recent
+  trace events and the run_id/attempt/rank identity.
 """
 
 from __future__ import annotations
@@ -21,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core import live as live_view
 from spark_examples_tpu.serve.server import (
     DeadlineExceeded,
     ProjectionServer,
@@ -59,24 +68,13 @@ def _make_handler(pserver: ProjectionServer):
                 })
                 return
             if self.path == "/stats":
-                hists = telemetry.metrics_snapshot()["histograms"]
-                lat = hists.get("serve.latency_s", {})
-                rows = hists.get("serve.batch_rows", {})
-                payload = {
-                    **pserver.stats.snapshot(),
-                    "latency_p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
-                    "latency_p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
-                    "batch_rows_mean": round(rows.get("mean", 0.0), 2),
-                    "worker_restarts": pserver._worker_restarts,
-                    "health": pserver.health,
-                }
-                # Panel staged from a dataset store: surface the decode
-                # cache's hit/miss/eviction accounting (the cold-start
-                # staging story; absent for non-store panels).
-                store_cache = pserver.engine.store_cache_stats()
-                if store_cache is not None:
-                    payload["store_cache"] = store_cache
-                self._reply(200, payload)
+                self._reply(200, pserver.stats_payload())
+                return
+            if self.path == "/metrics":
+                live_view.reply_metrics(self)
+                return
+            if self.path == "/debug/telemetry":
+                live_view.reply_debug_telemetry(self)
                 return
             self._reply(404, {"error": f"unknown path {self.path!r}"})
 

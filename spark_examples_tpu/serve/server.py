@@ -208,6 +208,37 @@ class ProjectionServer:
                         else None),
         }
 
+    def stats_payload(self) -> dict:
+        """The ``/stats`` payload — ONE coherent schema (documented in
+        README "Serving"):
+
+        - request accounting, flat (``admitted``/``completed``/
+          ``shed``/``cache_hits``/``errors``/``deadline_expired``/
+          ``cancelled``/``batches``),
+        - ``latency_p50_ms``/``latency_p99_ms``/``batch_rows_mean``
+          from the live telemetry histograms,
+        - ``health`` — the full health-machine view
+          (:meth:`health_info`: status string, worker restarts +
+          liveness, panel mode, circuit-breaker snapshot), previously
+          scattered between /healthz and ad-hoc /stats fields,
+        - ``store_cache`` — the staged panel's decode-cache accounting
+          (absent for non-store panels).
+        """
+        hists = telemetry.metrics_snapshot()["histograms"]
+        lat = hists.get("serve.latency_s", {})
+        rows = hists.get("serve.batch_rows", {})
+        payload = {
+            **self.stats.snapshot(),
+            "latency_p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+            "latency_p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+            "batch_rows_mean": round(rows.get("mean", 0.0), 2),
+            "health": self.health_info(),
+        }
+        store_cache = self.engine.store_cache_stats()
+        if store_cache is not None:
+            payload["store_cache"] = store_cache
+        return payload
+
     def _note_recovery(self, reason: str) -> None:
         self._worker_restarts += 1
         self._last_recovery = time.monotonic()

@@ -23,11 +23,32 @@ SERVE_KILL_POINTS = (0, 2, 4)    # serve.request hit
 SKETCH_KILL_POINTS = (1, 4, 9)   # pass 0 early, pass 0 late, pass 1
 
 
+_CACHE_DIR = None  # session-scoped jax compile cache for the children
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _session_compile_cache(tmp_path_factory):
+    # Isolate the children's persistent jax compile cache from the
+    # user-level ~/.cache one: an executable cached there by some
+    # OTHER run (different session, different shapes) can carry a
+    # different reduction order at the same shape, and a clean-vs-
+    # resumed comparison then fails on float LSBs for reasons that
+    # have nothing to do with resume correctness. One shared dir per
+    # test session keeps the matrix fast (children reuse each other's
+    # compiles) and hermetic — and pytest's tmp_path_factory retires
+    # it, unlike a bare mkdtemp.
+    global _CACHE_DIR
+    _CACHE_DIR = str(tmp_path_factory.mktemp("killmatrix-jax-cache"))
+    yield
+    _CACHE_DIR = None
+
+
 def _env(**extra):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        SPARK_EXAMPLES_TPU_CACHE=_CACHE_DIR,
     )
     env.update(extra)
     return env

@@ -1,0 +1,508 @@
+"""Live telemetry plane (core/telemetry.py periodic flusher +
+core/live.py surfaces + core/stitch.py restart stitching).
+
+The acceptance story (ISSUE 9): a supervised streaming job killed and
+restarted mid-run yields (a) a scrapeable /metrics endpoint that stays
+live across the restart via the parent proxy and (b) ONE stitched
+Perfetto trace spanning both attempts with a restart marker — proven by
+subprocess tests at the bottom. The unit layers above them pin the
+pieces: snapshot atomicity under concurrent writers, crash-flush /
+periodic-flush interaction, the telemetry.flush fault site, Prometheus
+rendering, the sidecar, and the proxy's stale-answer behavior.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from spark_examples_tpu.core import faults, live, stitch, supervisor, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.stop_periodic_flush()
+    telemetry.configure(dir=None)
+    telemetry.reset()
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+# ---------------------------------------------------------------- snapshot API
+
+
+def test_live_snapshot_carries_identity_and_recent_events(tmp_path):
+    telemetry.configure(dir=str(tmp_path), trace_events=True)
+    telemetry.count("faults.fired")
+    for _ in range(3):
+        with telemetry.span("checkpoint.save", cat="checkpoint"):
+            pass
+    snap = telemetry.live_snapshot(recent=2)
+    assert snap["counters"]["faults.fired"] == 1
+    assert snap["histograms"]["checkpoint.save"]["count"] == 3
+    assert len(snap["recent_events"]) == 2  # the rolling ring, not all
+    assert snap["meta"]["run_id"] and snap["meta"]["attempt"] == 0
+    assert snap["meta"]["epoch_unix_s"] <= snap["meta"]["wrote_unix_s"]
+
+
+def test_recent_events_ring_excludes_the_flushers_own_spans(tmp_path):
+    """During a stall the flusher keeps publishing while the job emits
+    nothing — its own live.flush spans must not displace the job events
+    the ring preserves for the killed-attempt stitch fallback."""
+    telemetry.configure(dir=str(tmp_path), trace_events=True)
+    with telemetry.span("gram.block", cat="gram"):
+        pass
+    for _ in range(telemetry.RECENT_EVENTS + 8):  # > ring capacity
+        with telemetry.span("live.flush", cat="live"):
+            pass
+    names = {ev["name"] for ev in telemetry.recent_events()}
+    assert "live.flush" not in names
+    assert "gram.block" in names  # the job event survived the flood
+
+
+def test_progress_token_ignores_live_plane_counters():
+    """A flusher publishing (or an operator scraping) every few seconds
+    must not make a stalled job look alive to the watchdog — including
+    once the trace buffer is full, when every flusher span advances
+    telemetry.dropped_events on pure wall-clock."""
+    t0 = supervisor.progress_token()
+    telemetry.count("live.flushes")
+    telemetry.count("live.requests", 5)
+    telemetry.observe("live.flush", 0.001)
+    telemetry.count("telemetry.dropped_events")  # full-buffer flushes
+    assert supervisor.progress_token() == t0
+    telemetry.count("faults.fired")  # real instrumented work does move it
+    assert supervisor.progress_token() > t0
+
+
+# ------------------------------------------------------------ periodic flusher
+
+
+def test_periodic_flusher_publishes_atomic_monotonic_snapshots(tmp_path):
+    """Satellite: concurrent observe() during snapshots must never
+    produce a torn or non-monotonic export — every read of the
+    published metrics.json parses, histogram counts only grow, and
+    the final publish holds every sample."""
+    telemetry.configure(dir=str(tmp_path), trace_events=True)
+    stop = threading.Event()
+    wrote = [0]
+
+    def hammer():
+        while not stop.is_set():
+            telemetry.observe("serve.latency_s", 0.001)
+            wrote[0] += 1
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    flusher = telemetry.start_periodic_flush(0.005)
+    path = tmp_path / "rank0" / "metrics.json"
+    last_count = -1
+    reads = 0
+    deadline = time.time() + 3.0
+    try:
+        while time.time() < deadline and reads < 40:
+            try:
+                with open(path) as f:
+                    snap = json.load(f)  # atomic: never torn
+            except OSError:
+                continue  # first flush not landed yet
+            h = snap["histograms"].get("serve.latency_s", {"count": 0})
+            assert h["count"] >= last_count, "non-monotonic export"
+            if h["count"]:
+                # internally consistent summary, not a half-recorded one
+                assert h["sum"] >= h["count"] * 0.0009
+                assert h["min"] <= h["p50"] <= h["max"]
+            last_count = h["count"]
+            reads += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    flusher.stop()
+    telemetry.stop_periodic_flush()
+    assert reads >= 10 and last_count > 0
+    with open(path) as f:
+        final = json.load(f)
+    # stop() publishes one final snapshot: nothing recorded is lost
+    assert final["histograms"]["serve.latency_s"]["count"] == wrote[0]
+    assert final["counters"]["live.flushes"] >= 1
+    # the rolling event ring parses line-by-line too
+    with open(tmp_path / "rank0" / "live_trace.jsonl") as f:
+        ring = [json.loads(line) for line in f if line.strip()]
+    assert len(ring) <= telemetry.RECENT_EVENTS
+
+
+def test_flush_fault_is_absorbed_and_counted(tmp_path):
+    """The telemetry.flush chaos site: an injected io_error fails one
+    flush (warned once, counted), later flushes recover, and the
+    published snapshot is the last GOOD one."""
+    telemetry.configure(dir=str(tmp_path), trace_events=False)
+    telemetry.count("faults.fired")
+    flusher = telemetry.PeriodicFlusher(str(tmp_path), interval_s=0.01)
+    with faults.armed(["telemetry.flush:io_error:after=0:max=1"]):
+        with pytest.warns(RuntimeWarning, match="periodic telemetry flush"):
+            flusher.flush()  # the injected failure
+        flusher.flush()  # recovers
+    flusher.stop()
+    assert telemetry.counter_value("live.flush_errors") == 1
+    assert telemetry.counter_value("live.flushes") >= 1
+    with open(tmp_path / "rank0" / "metrics.json") as f:
+        snap = json.load(f)
+    assert snap["counters"]["faults.fired"] >= 1
+
+
+def test_kill_mid_flush_leaves_last_good_snapshot_readable(tmp_path):
+    """Crash-flush x periodic-flush interaction: a hard kill (os._exit,
+    no atexit, no SIGTERM handler) between flushes must leave the last
+    periodic snapshot complete and parseable."""
+    script = (
+        "import os, sys, time\n"
+        "from spark_examples_tpu.core import telemetry\n"
+        f"telemetry.configure(dir={str(tmp_path / 'tel')!r}, "
+        "trace_events=True, flush_s=0.01)\n"
+        "for i in range(50):\n"
+        "    telemetry.count('faults.fired')\n"
+        "    telemetry.observe('serve.latency_s', 0.001)\n"
+        "    time.sleep(0.005)\n"
+        "os._exit(113)\n"  # preemption: no flush hooks run
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", script],
+        env=dict(os.environ, PYTHONPATH=REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")),
+        capture_output=True, text=True, timeout=120)
+    assert p.returncode == 113
+    with open(tmp_path / "tel" / "rank0" / "metrics.json") as f:
+        snap = json.load(f)  # parses: the atomic-write contract held
+    assert snap["counters"]["faults.fired"] > 0
+    with open(tmp_path / "tel" / "rank0" / "live_trace.jsonl") as f:
+        for line in f:
+            if line.strip():
+                json.loads(line)
+
+
+# ------------------------------------------------------- prometheus + sidecar
+
+
+def test_prometheus_text_renders_every_metric_kind():
+    telemetry.count("faults.fired", 3)
+    telemetry.gauge_set("serve.in_flight", 2)
+    telemetry.observe("serve.latency_s", 0.25)
+    telemetry.count("phase.gram", 1.5)
+    text = live.prometheus_text()
+    assert "# TYPE faults_fired_total counter" in text
+    assert "faults_fired_total 3.0" in text
+    assert "serve_in_flight 2.0" in text
+    assert 'phase_seconds_total{phase="gram"} 1.5' in text
+    assert "# TYPE serve_latency_s summary" in text
+    assert 'serve_latency_s{quantile="0.5"}' in text
+    assert "serve_latency_s_count 1" in text
+    assert "telemetry_info{run_id=" in text
+
+
+def test_sidecar_endpoints_and_port_files(tmp_path):
+    telemetry.count("faults.fired")
+    port_file = tmp_path / "port"
+    announce = tmp_path / "announce"
+    server = live.maybe_start_live(environ={
+        live.ENV_PORT: "0",
+        live.ENV_PORT_FILE: str(port_file),
+        live.ENV_ANNOUNCE: str(announce),
+    })
+    assert server is not None
+    try:
+        assert int(port_file.read_text()) == server.port
+        assert announce.read_text() == f"127.0.0.1:{server.port}"
+        base = f"http://127.0.0.1:{server.port}"
+        assert b"faults_fired_total" in _get(f"{base}/metrics")
+        debug = json.loads(_get(f"{base}/debug/telemetry"))
+        assert debug["counters"]["faults.fired"] == 1
+        health = json.loads(_get(f"{base}/healthz"))
+        assert health["ok"] and health["run_id"]
+        assert telemetry.counter_value("live.requests") == 3
+    finally:
+        server.shutdown()
+
+
+def test_maybe_start_live_is_opt_in():
+    assert live.maybe_start_live(environ={}) is None
+
+
+# ---------------------------------------------------------------------- proxy
+
+
+def test_proxy_follows_child_and_serves_stale_when_down(tmp_path):
+    """The proxy answers from the live child when it is up, and from
+    the last-good cache (marked stale, supervisor series appended)
+    when it is down — the scrape that lands mid-restart succeeds."""
+    telemetry.count("faults.fired")
+    port_file = tmp_path / "child.port"
+    child = live.LiveTelemetryServer(port=0, port_file=str(port_file))
+    child.serve_in_thread()
+    state = {"run_id": "testrun", "attempt": 0, "restarts": 0,
+             "watchdog_kills": 0}
+    proxy = live.SupervisorLiveProxy(
+        "127.0.0.1", 0, str(port_file), lambda: dict(state))
+    proxy.serve_in_thread()
+    base = f"http://127.0.0.1:{proxy.port}"
+    try:
+        body = _get(f"{base}/metrics").decode()
+        assert "faults_fired_total" in body  # the child's series
+        assert "supervisor_restarts 0" in body
+        assert "supervisor_scrape_stale 0" in body
+        debug = json.loads(_get(f"{base}/debug/telemetry"))
+        assert debug["stale"] is False
+        assert debug["child"]["counters"]["faults.fired"] == 1
+
+        child.shutdown()  # the restart window
+        state["restarts"] = 1
+        state["attempt"] = 1
+        body = _get(f"{base}/metrics").decode()
+        assert "faults_fired_total" in body  # last-good cache
+        assert "supervisor_scrape_stale 1" in body
+        assert "supervisor_restarts 1" in body
+        assert "supervisor_child_up 0" in body
+        debug = json.loads(_get(f"{base}/debug/telemetry"))
+        assert debug["stale"] is True
+        assert debug["supervisor"]["restarts"] == 1
+        health = json.loads(_get(f"{base}/healthz"))
+        assert health["ok"] and health["child_up"] is False
+        assert telemetry.counter_value("live.proxy_stale") >= 2
+    finally:
+        proxy.shutdown()
+        child.shutdown()
+
+
+# --------------------------------------------------------------------- stitch
+
+
+def _write_attempt(base, att, rank, epoch, run_id, events):
+    d = os.path.join(base, f"attempt{att}", f"rank{rank}")
+    os.makedirs(d)
+    with open(os.path.join(d, "metrics.json"), "w") as f:
+        json.dump({"counters": {}, "meta": {
+            "rank": rank, "attempt": att, "run_id": run_id,
+            "epoch_unix_s": epoch}}, f)
+    with open(os.path.join(d, "trace.jsonl"), "w") as f:
+        f.write(json.dumps({"name": "process_name", "ph": "M",
+                            "pid": rank, "ts": 0, "args": {}}) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def test_stitch_merges_attempts_on_one_timeline(tmp_path):
+    base = str(tmp_path / "tel")
+    ev = {"name": "gram.block", "cat": "gram", "ph": "X", "dur": 5.0,
+          "tid": 1, "args": {}}
+    _write_attempt(base, 0, 0, 1000.0, "rid1", [{**ev, "ts": 10.0}])
+    _write_attempt(base, 1, 0, 1002.5, "rid1", [{**ev, "ts": 10.0}])
+    with open(os.path.join(base, "supervisor.json"), "w") as f:
+        json.dump({"run_id": "rid1", "restarts": 1, "incidents": [
+            {"attempt": 0, "kind": "crash", "detail": "exit code 113",
+             "returncode": 113, "t_unix": 1002.0}]}, f)
+    report = stitch.stitch(base)
+    assert report["attempts"] == [0, 1]
+    assert report["events"] == 2
+    assert report["restart_markers"] == 1
+    assert report["run_ids"] == ["rid1"] and not report["mixed_run_ids"]
+    lines = [json.loads(line)
+             for line in open(report["output"]) if line.strip()]
+    spans = [e for e in lines if e.get("name") == "gram.block"]
+    # attempt 1's identical local ts lands 2.5 s later on the global
+    # timeline, on its own pid track
+    assert spans[0]["ts"] == 10.0 and spans[1]["ts"] == 2.5e6 + 10.0
+    assert spans[0]["pid"] != spans[1]["pid"]
+    marker = next(e for e in lines if e["name"] == "restart: crash")
+    assert marker["ph"] == "i" and marker["s"] == "g"
+    assert marker["ts"] == pytest.approx(2.0e6)
+    names = {e["args"].get("name") for e in lines if e.get("ph") == "M"}
+    assert {"attempt 0 rank 0", "attempt 1 rank 0",
+            "supervisor"} <= names
+
+
+def test_stitch_flags_mixed_run_ids_and_flat_layout(tmp_path):
+    base = str(tmp_path / "tel")
+    os.makedirs(os.path.join(base, "rank0"))
+    with open(os.path.join(base, "rank0", "metrics.json"), "w") as f:
+        json.dump({"meta": {"rank": 0, "attempt": 0, "run_id": "a",
+                            "epoch_unix_s": 5.0}}, f)
+    with open(os.path.join(base, "rank0", "trace.jsonl"), "w") as f:
+        f.write(json.dumps({"name": "gram.block", "ph": "X", "ts": 1.0,
+                            "dur": 1.0, "tid": 0, "args": {}}) + "\n")
+    _write_attempt(base, 1, 0, 6.0, "b", [])
+    report = stitch.stitch(base)
+    assert report["mixed_run_ids"] and report["run_ids"] == ["a", "b"]
+    assert report["events"] == 1
+
+
+def test_stitch_rejects_emptiness(tmp_path):
+    with pytest.raises(stitch.StitchError):
+        stitch.stitch(str(tmp_path))
+
+
+def test_stitch_falls_back_to_live_ring_for_killed_attempt(tmp_path):
+    """A killed attempt has no exit-time trace.jsonl; its periodic
+    live_trace.jsonl ring must still appear in the session trace."""
+    base = str(tmp_path / "tel")
+    d = os.path.join(base, "attempt0", "rank0")
+    os.makedirs(d)
+    with open(os.path.join(d, "metrics.json"), "w") as f:
+        json.dump({"meta": {"rank": 0, "attempt": 0, "run_id": "r",
+                            "epoch_unix_s": 0.0}}, f)
+    with open(os.path.join(d, "live_trace.jsonl"), "w") as f:
+        f.write(json.dumps({"name": "gram.block", "ph": "X", "ts": 3.0,
+                            "dur": 1.0, "tid": 0, "args": {}}) + "\n")
+    report = stitch.stitch(base)
+    assert report["events"] == 1
+
+
+# ----------------------------------------------- supervised acceptance (E2E)
+
+
+def _cli_env(**extra):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        **{supervisor.ENV_HEARTBEAT_INTERVAL: "0.1"},
+    )
+    env.update(extra)
+    return env
+
+
+def test_supervised_kill_restart_proxy_and_stitch(tmp_path):
+    """THE acceptance test: a supervised streaming job is killed
+    mid-run by an injected fault and restarted; the parent's /metrics
+    proxy answers before, during, and after the restart (the restart
+    itself visible in the supervisor series), and `telemetry stitch`
+    yields one Perfetto trace spanning both attempts with a restart
+    marker."""
+    tel = str(tmp_path / "tel")
+    announce = tmp_path / "announce"
+    env = _cli_env(**{
+        # kill at the 4th host->device transfer; a per-block delay
+        # widens the scrape window (stripped, like the kill, on the
+        # restarted attempt)
+        faults.ENV_SPECS: ("device.put:kill:after=3;"
+                           "device.put:delay:delay=0.05:max=0"),
+        live.ENV_ANNOUNCE: str(announce),
+    })
+    cmd = [
+        sys.executable, "-m", "spark_examples_tpu", "similarity",
+        "--n-samples", "16", "--n-variants", "2048",
+        "--block-variants", "128",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-every-blocks", "2",
+        "--telemetry-dir", tel, "--telemetry-flush-s", "0.05",
+        "--live-port", "0", "--supervise",
+        "--output-path", str(tmp_path / "out.tsv"),
+    ]
+    proc = subprocess.Popen(cmd, env=env, cwd=str(tmp_path),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        base = None
+        deadline = time.time() + 60
+        while base is None and time.time() < deadline:
+            try:
+                base = "http://" + announce.read_text().strip()
+            except OSError:
+                time.sleep(0.05)
+        assert base, "proxy never announced its endpoint"
+        scrapes = restart_seen = child_metric_seen = 0
+        while proc.poll() is None and time.time() - deadline < 240:
+            try:
+                body = _get(f"{base}/metrics", timeout=2).decode()
+            except Exception:
+                time.sleep(0.05)
+                continue  # transient socket teardown, keep polling
+            scrapes += 1
+            if "supervisor_restarts 1" in body:
+                restart_seen += 1
+            if "gram_block" in body:
+                child_metric_seen += 1
+            time.sleep(0.05)
+        _, stderr = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, stderr[-2000:]
+    assert "supervisor: attempt 0: crash: exit code 113" in stderr
+    # (a) the endpoint stayed live across the restart: scrapes landed
+    # throughout, and the restart itself became visible in the
+    # supervisor series while the job kept running
+    assert scrapes >= 5
+    assert restart_seen >= 1, "restart never visible on /metrics"
+    assert child_metric_seen >= 1, "child series never proxied"
+    # (b) one stitched trace spanning both attempts + restart marker,
+    # via the CLI verb
+    p = subprocess.run(
+        [sys.executable, "-m", "spark_examples_tpu", "telemetry",
+         "stitch", "--path", tel],
+        env=_cli_env(), capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr
+    report = json.loads(p.stdout)
+    assert report["attempts"] == [0, 1]
+    assert report["restart_markers"] == 1
+    assert not report["mixed_run_ids"]  # one run_id across attempts
+    lines = [json.loads(line)
+             for line in open(report["output"]) if line.strip()]
+    pids = {e["pid"] for e in lines if e.get("name") == "gram.block"}
+    assert len(pids) == 2, "blocks from both attempts on their tracks"
+    assert any(e.get("cat") == "supervisor" for e in lines)
+
+
+def test_unsupervised_live_port_sidecar_cli(tmp_path):
+    """--live-port on a plain batch job: /metrics scrapeable mid-run,
+    with job series present."""
+    announce = tmp_path / "announce"
+    env = _cli_env(**{
+        live.ENV_ANNOUNCE: str(announce),
+        faults.ENV_SPECS: "device.put:delay:delay=0.05:max=0",
+    })
+    cmd = [
+        sys.executable, "-m", "spark_examples_tpu", "similarity",
+        "--n-samples", "16", "--n-variants", "1024",
+        "--block-variants", "128", "--live-port", "0",
+        "--output-path", str(tmp_path / "out.tsv"),
+    ]
+    proc = subprocess.Popen(cmd, env=env, cwd=str(tmp_path),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        base = None
+        deadline = time.time() + 60
+        while base is None and time.time() < deadline:
+            try:
+                base = "http://" + announce.read_text().strip()
+            except OSError:
+                time.sleep(0.05)
+        assert base, "sidecar never announced"
+        saw_series = False
+        while proc.poll() is None:
+            try:
+                body = _get(f"{base}/metrics", timeout=2).decode()
+                if "ingest_bytes_total" in body:
+                    saw_series = True
+            except Exception:
+                pass
+            time.sleep(0.05)
+        _, stderr = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, stderr[-2000:]
+    assert saw_series, "job series never scrapeable mid-run"

@@ -297,6 +297,27 @@ def test_http_front(rng, tmp_path):
         with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
             stats = json.loads(r.read())
         assert stats["completed"] >= 1
+        # /stats folds the health machine + breaker + worker restarts
+        # into one coherent object (the live-telemetry-plane satellite)
+        assert stats["health"]["status"] == "healthy"
+        assert stats["health"]["worker_restarts"] == 0
+        assert stats["health"]["worker_alive"]
+        assert stats["health"]["breaker"]["state"] == "closed"
+        assert stats["latency_p99_ms"] >= stats["latency_p50_ms"]
+        # /metrics: Prometheus text over the live registry, mid-run
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            prom = r.read().decode()
+        assert "serve_requests_total" in prom
+        assert 'serve_latency_s{quantile="0.99"}' in prom
+        assert "telemetry_info{run_id=" in prom
+        # /debug/telemetry: the full live snapshot as JSON
+        with urllib.request.urlopen(f"{base}/debug/telemetry",
+                                    timeout=30) as r:
+            debug = json.loads(r.read())
+        assert debug["counters"]["serve.requests"] >= 1
+        assert debug["meta"]["run_id"]
+        assert "recent_events" in debug
         # malformed bodies are 400s, not dropped sockets: wrong type,
         # out-of-int8-range dosages, float dosages
         for body in (b'{"genotypes": "nope"}',

@@ -125,6 +125,75 @@ def test_registry_is_well_formed():
             assert len(name) > 2, name
 
 
+_GLOSSARY_HEADER = "### Exported telemetry metrics (glossary)"
+_TOKEN = re.compile(r"`([a-z0-9_.<>*]+)`")
+
+
+def _glossary_tokens():
+    """Names documented in BASELINE.md's glossary table.
+
+    Returns (expanded, first_cells): ``expanded`` is every backticked
+    token from the name/kind/description cells with the table's
+    shorthand resolved — ``phase.<name>`` -> the ``phase.*`` family,
+    and slash rows like ``checkpoint.save`/`write`` expand the bare
+    tail against the previous dotted token's prefix; ``first_cells``
+    is the same but name-cells only (held to the stricter
+    every-token-declared contract — description prose may mention
+    files and APIs that are not metrics)."""
+    text = (REPO / "BASELINE.md").read_text()
+    start = text.index(_GLOSSARY_HEADER)
+    section = text[start:]
+    nxt = section.find("\n### ", 1)
+    if nxt != -1:
+        section = section[:nxt]
+    expanded: set[str] = set()
+    first_cells: set[str] = set()
+    for line in section.splitlines():
+        if not line.startswith("| `"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        for i, cell in enumerate(cells[:3]):
+            prefix = None
+            for tok in _TOKEN.findall(cell):
+                tok = tok.replace("<name>", "*")
+                if "." in tok:
+                    prefix = tok.rsplit(".", 1)[0]
+                elif prefix is not None:
+                    tok = f"{prefix}.{tok}"
+                expanded.add(tok)
+                if i == 0:
+                    first_cells.add(tok)
+    return expanded, first_cells
+
+
+def test_every_registry_name_has_a_glossary_row_and_vice_versa():
+    """Satellite lint: PRs 4-7 hand-maintained the BASELINE.md metric
+    glossary next to telemetry.NAMES; catch the drift mechanically in
+    BOTH directions — a registered name missing from the glossary is
+    an undocumented export, and a glossary row naming something
+    undeclared documents a metric that does not exist."""
+    expanded, first_cells = _glossary_tokens()
+    missing = [
+        name for name in telemetry.NAMES
+        if name not in expanded
+    ]
+    assert not missing, (
+        "telemetry.NAMES entries without a BASELINE.md glossary row "
+        "(add one to 'Exported telemetry metrics'): "
+        + ", ".join(sorted(missing))
+    )
+    phantom = [
+        tok for tok in sorted(first_cells)
+        if not (tok in telemetry.NAMES or telemetry.is_declared(tok)
+                or tok.endswith(".*"))
+    ]
+    assert not phantom, (
+        "BASELINE.md glossary rows naming metrics that are not in "
+        "telemetry.NAMES (registry and glossary must move together): "
+        + ", ".join(phantom)
+    )
+
+
 def test_core_names_present():
     # The instrumentation contract of this PR — removing one of these
     # silently un-instruments a subsystem.
@@ -194,6 +263,16 @@ def test_core_names_present():
         "solver.rank",
         "solver.state_bytes",
         "solver.nxn_bytes_avoided",
+        # live telemetry plane + trend tracking (this PR's
+        # instrumentation contract)
+        "live.flush",
+        "live.flushes",
+        "live.flush_errors",
+        "live.requests",
+        "live.proxy_requests",
+        "live.proxy_stale",
+        "trend.metrics_checked",
+        "trend.regressions",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution

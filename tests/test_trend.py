@@ -1,0 +1,233 @@
+"""Noise-aware perf regression tracking (tools/trend.py).
+
+Tier-1 acceptance: a synthetic 20% regression on a fixture history is
+flagged (nonzero exit through the CLI), and candidates inside the noise
+band stay quiet — in BOTH directions (latency-like metrics regress
+upward, throughput-like downward), with booleans gated and unknown
+metrics left alone.
+"""
+
+import json
+import subprocess
+import sys
+import os
+
+import pytest
+
+from tools import trend
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hist(name, values):
+    return [{"t_unix": 1000.0 + i, "run": {"round": i},
+             "metrics": {name: v}} for i, v in enumerate(values)]
+
+
+# ------------------------------------------------------------------ directions
+
+
+def test_metric_directions_resolve_sensibly():
+    d = trend.metric_direction
+    assert d("value") == trend.LOWER_IS_BETTER  # headline seconds
+    assert d("streamed_s") == trend.LOWER_IS_BETTER
+    assert d("serve_p99_ms") == trend.LOWER_IS_BETTER
+    assert d("sketch_relerr_vs_exact_2500") == trend.LOWER_IS_BETTER
+    assert d("sketch_peak_mb") == trend.LOWER_IS_BETTER
+    assert d("serve_sustained_qps") == trend.HIGHER_IS_BETTER
+    assert d("gram_tflops_staged") == trend.HIGHER_IS_BETTER
+    assert d("ingest_mb_s_packed") == trend.HIGHER_IS_BETTER
+    assert d("store_hit_vs_cold_parse") == trend.HIGHER_IS_BETTER
+    assert d("store_compact_scaling_w4_vs_w1") == trend.HIGHER_IS_BETTER
+    assert d("vs_baseline") == trend.HIGHER_IS_BETTER
+    assert d("store_ok") == trend.BOOL_MUST_HOLD
+    assert d("tunnel_mb_s") is None  # environment, never gated
+    assert d("metric") is None  # free-form string name
+
+
+# ------------------------------------------------------------------ the band
+
+
+def test_twenty_percent_regression_is_flagged_and_noise_is_not():
+    """THE acceptance pair: ~2% jitter history; +20% slower fires,
+    +2% stays inside the band."""
+    history = _hist("streamed_s", [1.00, 1.02, 0.99, 1.01, 0.98, 1.00])
+    bad = trend.check_trend(history, {"streamed_s": 1.20})
+    assert not bad["ok"]
+    assert bad["regressions"][0]["metric"] == "streamed_s"
+    quiet = trend.check_trend(history, {"streamed_s": 1.02})
+    assert quiet["ok"] and not quiet["regressions"]
+    # a 20% IMPROVEMENT is reported, never fatal
+    better = trend.check_trend(history, {"streamed_s": 0.80})
+    assert better["ok"]
+    assert better["improvements"][0]["metric"] == "streamed_s"
+
+
+def test_direction_awareness_for_throughput():
+    """qps DROPPING 20% regresses; qps rising 20% improves."""
+    history = _hist("serve_sustained_qps", [100, 102, 99, 101, 98, 100])
+    drop = trend.check_trend(history, {"serve_sustained_qps": 80.0})
+    assert not drop["ok"]
+    rise = trend.check_trend(history, {"serve_sustained_qps": 120.0})
+    assert rise["ok"] and rise["improvements"]
+
+
+def test_noisy_metric_gets_a_wider_band():
+    """Run-to-run jitter widens the band: a swing that would fire on a
+    stable metric stays quiet on one whose history already moves that
+    much (the dev-tunnel lesson from rounds 3-4)."""
+    noisy = _hist("streamed_s", [1.0, 1.8, 0.9, 1.7, 1.1, 1.6])
+    r = trend.check_trend(noisy, {"streamed_s": 2.0})
+    assert r["ok"], r["regressions"]
+    stable = _hist("streamed_s", [1.0, 1.01, 0.99, 1.0, 1.0, 1.01])
+    r2 = trend.check_trend(stable, {"streamed_s": 2.0})
+    assert not r2["ok"]
+
+
+def test_boolean_gate_and_short_history():
+    history = _hist("store_ok", [True, True, True])
+    assert not trend.check_trend(history, {"store_ok": False})["ok"]
+    assert trend.check_trend(history, {"store_ok": True})["ok"]
+    # too-short numeric history: skipped, never guessed
+    short = _hist("streamed_s", [1.0, 1.0])
+    r = trend.check_trend(short, {"streamed_s": 9.0})
+    assert r["ok"]
+    assert any("history too short" in s["why"] for s in r["skipped"])
+
+
+def test_backend_filter_keeps_environments_apart():
+    """A CPU dev-box run must neither gate against the chip history
+    (spurious regression) nor pollute the window a later chip run is
+    gated against (MAD inflation masking real regressions)."""
+    tpu = [{"t_unix": float(i), "run": {"backend": "tpu"},
+            "metrics": {"streamed_s": v}}
+           for i, v in enumerate([1.0, 1.01, 0.99, 1.0])]
+    cpu_value = 400.0  # same metric name, different physical quantity
+    # the CPU candidate against mixed history: with its backend
+    # honored there is no CPU history yet -> skipped, not a regression
+    cand = {"run": {"backend": "cpu"}, "metrics": {"streamed_s": cpu_value}}
+    r = trend.check_trend(tpu, cand, backend="cpu")
+    assert r["ok"] and any("history too short" in s["why"]
+                           for s in r["skipped"])
+    # a chip candidate ignores an interleaved CPU outlier record
+    mixed = tpu + [{"t_unix": 9.0, "run": {"backend": "cpu"},
+                    "metrics": {"streamed_s": cpu_value}}]
+    bad_chip = trend.check_trend(mixed, {"streamed_s": 1.2},
+                                 backend="tpu")
+    assert not bad_chip["ok"]  # the 20% chip regression still fires
+
+
+def test_check_and_count_defaults_to_candidate_backend(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    with open(path, "w") as f:
+        for i, v in enumerate([1.0, 1.0, 1.0, 1.0]):
+            f.write(json.dumps({"t_unix": float(i),
+                                "run": {"backend": "tpu"},
+                                "metrics": {"streamed_s": v}}) + "\n")
+        f.write(json.dumps({"t_unix": 9.0, "run": {"backend": "cpu"},
+                            "metrics": {"streamed_s": 400.0}}) + "\n")
+    # newest record is the CPU run: gated only against CPU history
+    # (none) -> clean skip, no spurious regression
+    report = trend.check_and_count(path)
+    assert report["ok"]
+
+
+def test_new_and_untracked_metrics_never_gate():
+    history = _hist("streamed_s", [1.0] * 5)
+    r = trend.check_trend(history, {"brand_new_s": 5.0,
+                                    "tunnel_mb_s": 3.0,
+                                    "note_string": "hi"})
+    assert r["ok"]
+
+
+# ---------------------------------------------------------------- substrate
+
+
+def test_append_load_round_trip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    rec = trend.append_history(path, {"streamed_s": 1.5, "store_ok": True,
+                                      "metric": "a_string"},
+                               run_meta={"argv": ["--store"]})
+    assert rec["metrics"] == {"streamed_s": 1.5, "store_ok": True}
+    assert rec["run"]["argv"] == ["--store"]
+    assert "platform" in rec["run"] and "git_sha" in rec["run"]
+    with open(path, "a") as f:
+        f.write('{"torn": ')  # crashed writer mid-line
+    loaded = trend.load_history(path)
+    assert len(loaded) == 1 and loaded[0]["metrics"]["streamed_s"] == 1.5
+
+
+def test_ingest_bench_round_files():
+    """The repo's own archived rounds are the backfill source; r05's
+    clipped (null) headline is skipped, not crashed on."""
+    files = [os.path.join(REPO, f"BENCH_r0{i}.json") for i in range(1, 6)]
+    records = trend.ingest_bench_files(files)
+    assert len(records) == 4  # r05's parsed headline was clipped to null
+    assert all("value" in r["metrics"] for r in records)
+    assert records[0]["run"]["source"] == "BENCH_r01.json"
+
+
+def test_repo_history_is_seeded_and_clean():
+    """BENCH_HISTORY.jsonl ships seeded from the archived rounds and
+    the newest record passes the gate against its own past."""
+    path = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+    history = trend.load_history(path)
+    assert len(history) >= 4
+    report = trend.check_and_count(path)
+    assert report["ok"], report["regressions"]
+
+
+# ----------------------------------------------------------------------- CLI
+
+
+def test_cli_check_exits_nonzero_on_regression(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    with open(path, "w") as f:
+        for rec in _hist("streamed_s", [1.0, 1.01, 0.99, 1.02, 1.0]):
+            f.write(json.dumps(rec) + "\n")
+    cand = tmp_path / "cand.json"
+
+    def run(value):
+        cand.write_text(json.dumps({"streamed_s": value}))
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "trend.py"),
+             "check", "--history", path, "--candidate", str(cand)],
+            capture_output=True, text=True, timeout=60)
+
+    ok = run(1.0)
+    assert ok.returncode == 0, ok.stderr
+    bad = run(1.2)
+    assert bad.returncode == 1
+    assert "REGRESSION streamed_s" in bad.stderr
+    report = json.loads(bad.stdout)
+    assert report["regressions"][0]["direction"] == "lower_is_better"
+
+
+def test_cli_ingest_appends(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trend.py"),
+         "ingest", "--history", path,
+         os.path.join(REPO, "BENCH_r02.json"),
+         os.path.join(REPO, "BENCH_r03.json")],
+        capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    assert len(trend.load_history(path)) == 2
+
+
+# ------------------------------------------------------------- telemetry tie
+
+
+def test_check_and_count_mirrors_into_telemetry(tmp_path):
+    from spark_examples_tpu.core import telemetry
+
+    telemetry.reset()
+    path = str(tmp_path / "hist.jsonl")
+    with open(path, "w") as f:
+        for rec in _hist("streamed_s", [1.0, 1.0, 1.0, 1.0]):
+            f.write(json.dumps(rec) + "\n")
+    report = trend.check_and_count(path, {"streamed_s": 2.0})
+    assert not report["ok"]
+    assert telemetry.counter_value("trend.metrics_checked") == 1
+    assert telemetry.counter_value("trend.regressions") == 1
+    telemetry.reset()

@@ -67,7 +67,7 @@ from spark_examples_tpu.store.writer import compact
 # Thread-name prefixes the soak owns end to end: any of these still
 # alive after a round (and a GC + settle window) is a leak.
 _SUSPECT_THREADS = ("store-readahead", "projection-serve-worker",
-                    "supervisor-heartbeat")
+                    "supervisor-heartbeat", "telemetry-flusher")
 
 # The in-process schedule: (job, site, kind, param ranges). `after` is
 # drawn per-round from its range so the fault lands at a different hit
@@ -95,11 +95,20 @@ SCENARIOS: tuple = (
     ("serve", "serve.request", "io_error", dict(after=(0, 5), max=(1, 1))),
     ("serve", "serve.request", "delay", dict(after=(0, 5), max=(1, 2),
                                              delay=0.02)),
+    # Every gram round runs a periodic live-telemetry flusher; a flush
+    # that fails must be absorbed (warned + counted) with the job —
+    # and every published snapshot — intact.
+    ("gram", "telemetry.flush", "io_error", dict(after=(0, 8), max=(1, 2))),
 )
 
 KILL_SCENARIOS: tuple = (
     ("cli", "ingest.block_read", "kill", dict(after=(2, 6), max=(1, 1))),
     ("cli", "store.read", "kill", dict(after=(1, 3), max=(1, 1))),
+    # Kill MID-FLUSH: the tmp+rename protocol must leave the last-good
+    # snapshot readable (checked by _snapshots_readable after every
+    # supervised round), and the restarted attempt completes
+    # bit-identically.
+    ("cli", "telemetry.flush", "kill", dict(after=(1, 4), max=(1, 1))),
 )
 
 
@@ -288,17 +297,52 @@ class _Fixture:
         return None
 
 
+def _snapshots_readable(tel_dir: str) -> str | None:
+    """Post-round live-snapshot invariant: every published
+    metrics.json / live_trace.jsonl under the round's telemetry dir
+    must parse — a flush that failed or a kill mid-write must have
+    left the LAST-GOOD file, never a torn one. A reason on violation."""
+    for root, _dirs, files in os.walk(tel_dir):
+        for name in files:
+            path = os.path.join(root, name)
+            try:
+                if name == "metrics.json":
+                    with open(path) as f:
+                        json.load(f)
+                elif name.endswith(".jsonl"):
+                    with open(path) as f:
+                        for line in f:
+                            if line.strip():
+                                json.loads(line)
+            except (OSError, ValueError) as e:
+                return (f"published snapshot {os.path.relpath(path, tel_dir)}"
+                        f" is not readable ({e}) — the atomic-write "
+                        "contract is broken")
+    return None
+
+
 def _run_gram_round(fx: _Fixture, i: int, spec: str,
                     round_seed: int) -> list[str]:
-    """One in-process gram round under `spec`; returns violations."""
+    """One in-process gram round under `spec`, with the periodic
+    live-telemetry flusher publishing snapshots throughout (the
+    telemetry.flush site fires inside it); returns violations."""
     problems: list[str] = []
     ckpt = os.path.join(fx.cfg.workdir, f"ck{i}")
+    tel = os.path.join(fx.cfg.workdir, f"ltel{i}")
+    flusher = telemetry.PeriodicFlusher(tel, interval_s=0.02)
     with faults.armed([spec], seed=round_seed):
         with warnings.catch_warnings():
             warnings.simplefilter("ignore", RuntimeWarning)
-            res = fx._gram_job(ckpt)
+            flusher.start()
+            try:
+                res = fx._gram_job(ckpt)
+            finally:
+                flusher.stop()
     if not np.array_equal(res.similarity, fx.baseline_sim):
         problems.append("gram result differs from clean baseline")
+    reason = _snapshots_readable(tel)
+    if reason:
+        problems.append(reason)
     return problems
 
 
@@ -341,18 +385,22 @@ def _run_serve_round(fx: _Fixture, spec: str,
 def _run_kill_round(fx: _Fixture, i: int, spec: str, round_seed: int,
                     baseline_tsv: bytes) -> tuple[list[str], int]:
     """One supervised subprocess round: the CLI job with an injected
-    kill, restarted by --supervise, output bytes vs the clean run.
+    kill, restarted by --supervise, output bytes vs the clean run —
+    with the periodic flusher live in every attempt, so a kill landing
+    mid-flush (the telemetry.flush scenario) must still leave each
+    attempt's last-good snapshot readable.
     Returns (violations, supervised restarts observed)."""
     cfg = fx.cfg
     out = os.path.join(cfg.workdir, f"kill{i}.tsv")
     ckpt = os.path.join(cfg.workdir, f"killck{i}")
+    tel = os.path.join(cfg.workdir, f"killtel{i}")
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         **{faults.ENV_SPECS: spec,
            faults.ENV_SEED: str(round_seed)},
     )
-    cmd = _cli_gram_cmd(fx, out, ckpt) + ["--supervise"]
+    cmd = _cli_gram_cmd(fx, out, ckpt, tel) + ["--supervise"]
     try:
         p = subprocess.run(cmd, env=env, capture_output=True, text=True,
                            timeout=cfg.kill_budget_s)
@@ -363,17 +411,22 @@ def _run_kill_round(fx: _Fixture, i: int, spec: str, round_seed: int,
     if p.returncode != 0:
         return [f"supervised run exited {p.returncode}: "
                 f"{p.stderr[-500:]}"], restarts
+    problems = []
+    reason = _snapshots_readable(tel)
+    if reason:
+        problems.append(reason)
     with open(out, "rb") as f:
         got = f.read()
     if got != baseline_tsv:
-        return ["supervised kill-resume output differs from the clean "
-                "run's bytes"], restarts
-    return [], restarts
+        problems.append("supervised kill-resume output differs from the "
+                        "clean run's bytes")
+    return problems, restarts
 
 
-def _cli_gram_cmd(fx: _Fixture, out: str, ckpt: str) -> list[str]:
+def _cli_gram_cmd(fx: _Fixture, out: str, ckpt: str,
+                  tel: str | None = None) -> list[str]:
     cfg = fx.cfg
-    return [
+    cmd = [
         sys.executable, "-m", "spark_examples_tpu", "similarity",
         "--source", f"store:{fx.store_dir}",
         "--block-variants", str(cfg.block_variants),
@@ -381,6 +434,9 @@ def _cli_gram_cmd(fx: _Fixture, out: str, ckpt: str) -> list[str]:
         "--checkpoint-dir", ckpt, "--checkpoint-every-blocks", "2",
         "--output-path", out,
     ]
+    if tel is not None:
+        cmd += ["--telemetry-dir", tel, "--telemetry-flush-s", "0.02"]
+    return cmd
 
 
 def run_soak(cfg: SoakConfig) -> SoakReport:

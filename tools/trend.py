@@ -1,0 +1,389 @@
+"""Noise-aware perf-regression tracking over bench headline history.
+
+BENCH_r01–r05 each printed a headline JSON and nobody diffed them: a
+perf regression only surfaced if a human compared files by hand. This
+module is the mechanical replacement, three pieces:
+
+- **Substrate.** :func:`append_history` appends one run's headline to
+  the append-only ``BENCH_HISTORY.jsonl`` — one JSON object per line,
+  ``{"t_unix", "run": {git sha, argv, platform...}, "metrics": {...}}``
+  — and :func:`ingest_bench_files` backfills it from the repo's
+  archived ``BENCH_r*.json`` round records (their ``parsed`` headline).
+  ``bench.py`` appends every run unconditionally, so the history exists
+  from day one.
+- **Direction registry.** Every headline metric has a *better*
+  direction — throughput up, latency down, relerr down, ``*_ok`` stays
+  true. :func:`metric_direction` resolves it from an explicit map plus
+  suffix rules; metrics with no known direction (free-form strings,
+  environment numbers like the dev tunnel rate) are not gated.
+- **Noise band.** A metric's recent history (trailing window) gives a
+  median and a MAD; the candidate regresses only when it is worse than
+  ``median ± max(mad_k·1.4826·MAD, rel_floor·|median|)`` in the bad
+  direction. Run-to-run jitter (the MAD) widens the band per metric, so
+  a noisy metric needs a big move to fire while a stable one is gated
+  tightly — and the relative floor keeps a zero-MAD history from
+  flagging 1% wiggles.
+
+Wired as ``bench.py --trend`` (append + check + nonzero exit on
+regression) and runnable standalone::
+
+    python tools/trend.py ingest --history BENCH_HISTORY.jsonl BENCH_r*.json
+    python tools/trend.py check  --history BENCH_HISTORY.jsonl
+    python tools/trend.py check  --history BENCH_HISTORY.jsonl --candidate headline.json
+
+Exit codes: 0 clean, 1 regression, 2 usage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+HISTORY_FILE = "BENCH_HISTORY.jsonl"
+
+# Defaults of the noise band. mad_k=4 on a consistency-scaled MAD
+# (1.4826·MAD estimates sigma for normal noise) keeps ordinary jitter
+# quiet; rel_floor guarantees a ±5% dead zone even on a constant
+# history (MAD 0), so sub-noise wiggles can never fire.
+WINDOW = 8
+MAD_K = 4.0
+REL_FLOOR = 0.05
+MIN_HISTORY = 3
+
+HIGHER_IS_BETTER = +1
+LOWER_IS_BETTER = -1
+BOOL_MUST_HOLD = 0
+
+# Explicit directions first — names whose suffix rules would guess
+# wrong, plus the cross-round headline anchors. None = tracked in the
+# history but never gated (environment numbers that measure the dev
+# tunnel / session, not the code).
+_EXPLICIT: dict[str, int | None] = {
+    "value": LOWER_IS_BETTER,  # headline seconds
+    "vs_baseline": HIGHER_IS_BETTER,
+    "streamed_vs_baseline": HIGHER_IS_BETTER,
+    # serve_vcf_s - serve_store_s: the cold-start time SAVED by staging
+    # from the store — a gain, despite the "_s" suffix.
+    "store_serve_cold_start_delta_s": HIGHER_IS_BETTER,
+    "tunnel_mb_s": None,  # session link rate: environment, not code
+    "cpu_baseline_s": None,  # the oracle's speed is not ours to gate
+    "chaos_soak_iterations": None,
+    "chaos_soak_healed": None,
+    "chaos_soak_faults_fired": None,
+}
+
+# (match kind, token, direction) — first hit wins, checked in order:
+# throughput tokens before the bare "_s" time suffix ("_mb_s" ends
+# with "_s" too), relerr before "_vs_" ("relerr_vs_exact" is an error,
+# not a speedup ratio).
+_RULES: tuple[tuple[str, str, int], ...] = (
+    ("contains", "relerr", LOWER_IS_BETTER),
+    ("contains", "_mb_s", HIGHER_IS_BETTER),
+    ("contains", "qps", HIGHER_IS_BETTER),
+    ("contains", "flops", HIGHER_IS_BETTER),
+    ("contains", "_vs_", HIGHER_IS_BETTER),
+    ("contains", "scaling", HIGHER_IS_BETTER),
+    ("contains", "separation", HIGHER_IS_BETTER),
+    ("suffix", "_peak_mb", LOWER_IS_BETTER),
+    ("suffix", "_bytes", LOWER_IS_BETTER),
+    ("suffix", "_ms", LOWER_IS_BETTER),
+    ("suffix", "_s", LOWER_IS_BETTER),
+)
+
+
+def metric_direction(name: str) -> int | None:
+    """+1 higher-is-better, -1 lower-is-better, 0 boolean gate, None =
+    untracked."""
+    if name in _EXPLICIT:
+        return _EXPLICIT[name]
+    if name.endswith("_ok"):
+        return BOOL_MUST_HOLD
+    for kind, token, direction in _RULES:
+        if kind == "suffix" and name.endswith(token):
+            return direction
+        if kind == "contains" and token in name:
+            return direction
+    return None
+
+
+def _scalar_metrics(headline: dict) -> dict:
+    """The gateable subset of a headline: top-level ints/floats/bools
+    (strings, nested dicts like the telemetry digest, and repro lines
+    stay in the raw record but are not trended)."""
+    out = {}
+    for k, v in headline.items():
+        if isinstance(v, bool):
+            out[k] = v
+        elif isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Substrate: the append-only history.
+
+
+def load_history(path: str) -> list[dict]:
+    """Parse the JSONL history; torn/garbage lines are skipped (the
+    file is append-only across crashes — a half-written tail must not
+    invalidate years of records)."""
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and isinstance(
+                        rec.get("metrics"), dict):
+                    records.append(rec)
+    except OSError:
+        pass
+    return records
+
+
+def run_metadata(extra: dict | None = None) -> dict:
+    """Who/where/what produced this run: git sha, platform, python —
+    the provenance a regression hunt needs first."""
+    meta = {
+        "platform": sys.platform,
+        "python": ".".join(map(str, sys.version_info[:3])),
+    }
+    try:
+        meta["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        meta["git_sha"] = None
+    if extra:
+        meta.update(extra)
+    return meta
+
+
+def append_history(path: str, headline: dict,
+                   run_meta: dict | None = None) -> dict:
+    """Append one run's headline to the history; returns the record."""
+    record = {
+        "t_unix": time.time(),
+        "run": run_metadata(run_meta),
+        "metrics": _scalar_metrics(headline),
+    }
+    with open(path, "a") as f:
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+    return record
+
+
+def ingest_bench_files(paths: list[str],
+                       backend: str = "tpu") -> list[dict]:
+    """Backfill records from archived round files: ``BENCH_r*.json``
+    round records (their ``parsed`` headline) or bare headline JSON.
+    The archived rounds all ran on the chip, so they are tagged
+    ``backend="tpu"`` by default — the backend tag is what keeps a
+    stray CPU bench run from gating against (or polluting) the chip
+    history (see :func:`check_trend`)."""
+    records = []
+    for p in sorted(paths):
+        with open(p) as f:
+            doc = json.load(f)
+        headline = doc.get("parsed", doc)
+        if not isinstance(headline, dict):
+            continue
+        metrics = _scalar_metrics(headline)
+        if not metrics:
+            continue
+        records.append({
+            "t_unix": os.path.getmtime(p),
+            "run": {"source": os.path.basename(p),
+                    "round": doc.get("n"),
+                    "backend": backend},
+            "metrics": metrics,
+        })
+    return records
+
+
+# ---------------------------------------------------------------------------
+# The check.
+
+
+def check_trend(history: list[dict], candidate: dict,
+                window: int = WINDOW, mad_k: float = MAD_K,
+                rel_floor: float = REL_FLOOR,
+                min_history: int = MIN_HISTORY,
+                backend: str | None = None) -> dict:
+    """Gate ``candidate`` (a metrics dict or a history record) against
+    the trailing ``window`` of ``history``. Returns the report:
+    ``ok`` (False iff any regression), ``regressions`` /
+    ``improvements`` / ``skipped`` per-metric details.
+
+    ``backend`` (e.g. ``"tpu"``) restricts the history window to runs
+    recorded with the same ``run.backend`` — seconds on a CPU dev box
+    and seconds on the chip are different quantities, and comparing
+    across them would both fire spurious regressions and widen the
+    MAD band enough to mask real ones. None = no filtering (fixture
+    histories and same-environment workflows)."""
+    if backend is not None:
+        history = [h for h in history
+                   if h.get("run", {}).get("backend") == backend]
+    cand = candidate.get("metrics", candidate)
+    report: dict = {"checked": 0, "regressions": [], "improvements": [],
+                    "skipped": []}
+    for name in sorted(cand):
+        direction = metric_direction(name)
+        value = cand[name]
+        if direction is None or not isinstance(value, (bool, int, float)):
+            report["skipped"].append({"metric": name, "why": "untracked"})
+            continue
+        series = [h["metrics"][name] for h in history
+                  if name in h.get("metrics", {})][-window:]
+        if direction == BOOL_MUST_HOLD:
+            report["checked"] += 1
+            if not value and any(series):
+                report["regressions"].append({
+                    "metric": name, "candidate": value,
+                    "why": "boolean gate was previously true",
+                })
+            continue
+        if len(series) < min_history:
+            report["skipped"].append({
+                "metric": name,
+                "why": f"history too short ({len(series)} < "
+                       f"{min_history})",
+            })
+            continue
+        med = statistics.median(series)
+        mad = statistics.median(abs(x - med) for x in series)
+        band = max(mad_k * 1.4826 * mad, rel_floor * abs(med))
+        delta = float(value) - med
+        report["checked"] += 1
+        entry = {
+            "metric": name,
+            "candidate": float(value),
+            "median": med,
+            "band": round(band, 6),
+            "delta": round(delta, 6),
+            "direction": ("higher_is_better" if direction > 0
+                          else "lower_is_better"),
+            "window": len(series),
+        }
+        # Direction-aware: only a move PAST the band edge in the bad
+        # direction regresses; the same move the other way is an
+        # improvement (reported, never fatal).
+        if delta * direction < -band:
+            report["regressions"].append(entry)
+        elif delta * direction > band:
+            report["improvements"].append(entry)
+    report["ok"] = not report["regressions"]
+    return report
+
+
+def check_and_count(history_path: str, candidate: dict | None = None,
+                    backend: str | None = None, **kw) -> dict:
+    """bench.py's entry: check the candidate (default: the history's
+    last record) against the records before it, mirroring the verdict
+    into the ``trend.*`` telemetry counters. When the candidate is a
+    history record carrying ``run.backend`` and no explicit
+    ``backend`` is given, the window filters to that backend."""
+    history = load_history(history_path)
+    if candidate is None:
+        if not history:
+            return {"ok": True, "checked": 0, "regressions": [],
+                    "improvements": [], "skipped": [],
+                    "note": "empty history"}
+        candidate, history = history[-1], history[:-1]
+    if backend is None:
+        backend = candidate.get("run", {}).get("backend") \
+            if isinstance(candidate.get("run"), dict) else None
+    report = check_trend(history, candidate, backend=backend, **kw)
+    try:
+        from spark_examples_tpu.core import telemetry
+
+        telemetry.count("trend.metrics_checked", report["checked"])
+        if report["regressions"]:
+            telemetry.count("trend.regressions",
+                            len(report["regressions"]))
+    except Exception:
+        pass  # the checker must run even without the package on path
+    return report
+
+
+def regression_lines(report: dict) -> list[str]:
+    """Human-readable one-liners for a report's regressions — THE
+    shared rendering, so bench.py's gate and this module's CLI cannot
+    drift apart on wording."""
+    return [
+        f"trend: REGRESSION {r['metric']}: {r.get('candidate')} vs "
+        f"median {r.get('median')} (band ±{r.get('band', 0)}, "
+        f"window {r.get('window', 0)})"
+        for r in report.get("regressions", [])
+    ]
+
+
+# ---------------------------------------------------------------------------
+# CLI.
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="noise-aware bench trend tracking")
+    sub = ap.add_subparsers(dest="verb", required=True)
+    p_in = sub.add_parser("ingest", help="backfill history from "
+                          "BENCH_r*.json / headline files")
+    p_in.add_argument("files", nargs="+")
+    p_in.add_argument("--history", default=HISTORY_FILE)
+    p_in.add_argument("--backend", default="tpu",
+                      help="run.backend tag stamped on the ingested "
+                      "records (default tpu — the archived rounds ran "
+                      "on the chip); pass cpu when backfilling dev-box "
+                      "headlines so they never gate the chip history")
+    p_ck = sub.add_parser("check", help="gate the newest record (or "
+                          "--candidate) against the trailing history")
+    p_ck.add_argument("--history", default=HISTORY_FILE)
+    p_ck.add_argument("--candidate", default=None,
+                      help="headline JSON file to gate (default: the "
+                      "history's own last record)")
+    p_ck.add_argument("--window", type=int, default=WINDOW)
+    p_ck.add_argument("--mad-k", type=float, default=MAD_K)
+    p_ck.add_argument("--rel-floor", type=float, default=REL_FLOOR)
+    p_ck.add_argument("--backend", default=None,
+                      help="gate only against history runs recorded "
+                      "with this run.backend (e.g. tpu); default: the "
+                      "candidate record's own backend when it has one")
+    args = ap.parse_args(argv)
+
+    if args.verb == "ingest":
+        records = ingest_bench_files(args.files, backend=args.backend)
+        with open(args.history, "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        print(f"ingested {len(records)} record(s) -> {args.history}")
+        return 0
+
+    candidate = None
+    if args.candidate:
+        with open(args.candidate) as f:
+            doc = json.load(f)
+        candidate = doc.get("parsed", doc)
+    report = check_and_count(args.history, candidate,
+                             backend=args.backend,
+                             window=args.window, mad_k=args.mad_k,
+                             rel_floor=args.rel_floor)
+    print(json.dumps(report, sort_keys=True))
+    for line in regression_lines(report):
+        print(line, file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
