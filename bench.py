@@ -963,14 +963,19 @@ def bench_serve(store: str) -> dict:
 
 
 STORE_BENCH_VARIANTS = 16_384  # store-bench cohort width (full N_SAMPLES)
+STORE_BENCH_CHUNK = 2_048      # store-bench chunk grid: 8 chunks, so the
+                               # readahead pool / adaptive depth have a
+                               # stream to work on (1 chunk = degenerate)
 
 
 def bench_store(store: str) -> dict:
     """``--store``: the content-addressed dataset store's bench numbers.
 
-    The bench cohort is a 2504 x 16384 prefix of the config-1 cohort
-    written as a real VCF (cached) — the "parse from scratch" cost every
-    run used to pay. Measured: cold VCF parse throughput (the old
+    The bench cohort is 2504 x 16384 with a realistic (log-uniform MAF)
+    site-frequency spectrum written as a real VCF (cached) — the "parse
+    from scratch" cost every run used to pay, over data shaped like the
+    data the codec actually meets. Measured: cold VCF parse throughput
+    (the old
     steady state), one-time compaction throughput (VCF -> store), the
     store read path cold (mmap + first-touch sha256 verify + 2-bit
     decode) and hot (decode-cache hit), a PCoA bit-identity round trip
@@ -982,6 +987,7 @@ def bench_store(store: str) -> dict:
     import shutil
     import tempfile
 
+    from spark_examples_tpu.core import telemetry
     from spark_examples_tpu.ingest.packed import load_packed
     from spark_examples_tpu.ingest.vcf import VcfSource, write_vcf
     from spark_examples_tpu.pipelines.jobs import pcoa_job
@@ -991,17 +997,30 @@ def bench_store(store: str) -> dict:
     nv = STORE_BENCH_VARIANTS
     dense_mb = N_SAMPLES * nv / 1e6
 
-    vcf_path = os.path.join(CACHE, f"store_bench_{N_SAMPLES}x{nv}.vcf")
+    # A realistic site-frequency spectrum, not the uniform-MAF synthetic
+    # cohort: real cohorts are dominated by rare variants (hom-ref runs),
+    # which is the shape chunk compression earns its ratio on — uniform
+    # MAF is near-max-entropy and would report ~1.2x where 1000G-like
+    # data gives several-fold. Log-uniform MAF in [0.002, 0.5] is the
+    # standard neutral-spectrum stand-in.
+    vcf_path = os.path.join(CACHE, f"store_bench_sfs_{N_SAMPLES}x{nv}.vcf")
     if not os.path.exists(vcf_path):
-        log(f"writing store-bench VCF ({N_SAMPLES} x {nv}, cached)...")
-        src = _slice_store(store, nv)
-        g = np.concatenate([b for b, _ in src.blocks(BLOCK)], axis=1)
+        log(f"writing store-bench VCF ({N_SAMPLES} x {nv}, "
+            "SFS-realistic, cached)...")
+        rng = np.random.default_rng(0xFEED)
+        maf = 10.0 ** rng.uniform(np.log10(0.002), np.log10(0.5), nv)
+        g = rng.binomial(2, maf[None, :],
+                         (N_SAMPLES, nv)).astype(np.int8)
+        g[rng.random((N_SAMPLES, nv)) < 0.01] = -1
         ids = load_packed(store).sample_ids
         write_vcf(vcf_path, g, sample_ids=ids)
 
     def _stream_s(source) -> float:
+        # Stream at the chunk grid so the pass IS a stream (a width
+        # covering the whole cohort would be one read_range call with
+        # nothing for readahead to run ahead of).
         t0 = time.perf_counter()
-        for _b, _m in source.blocks(BLOCK):
+        for _b, _m in source.blocks(STORE_BENCH_CHUNK):
             pass
         return time.perf_counter() - t0
 
@@ -1017,12 +1036,12 @@ def bench_store(store: str) -> dict:
     store_dir_w1 = tempfile.mkdtemp(prefix="storebench_w1_", dir=CACHE)
     try:
         t0 = time.perf_counter()
-        compact(store_dir_w1, VcfSource(vcf_path), chunk_variants=BLOCK,
-                workers=1)
+        compact(store_dir_w1, VcfSource(vcf_path),
+                chunk_variants=STORE_BENCH_CHUNK, workers=1)
         compact_w1_s = time.perf_counter() - t0
         t0 = time.perf_counter()
         manifest = compact(store_dir, VcfSource(vcf_path),
-                           chunk_variants=BLOCK, workers=4)
+                           chunk_variants=STORE_BENCH_CHUNK, workers=4)
         compact_s = time.perf_counter() - t0
         with open(os.path.join(store_dir, "manifest.json"), "rb") as f:
             m4 = f.read()
@@ -1030,16 +1049,81 @@ def bench_store(store: str) -> dict:
             m1 = f.read()
         compact_deterministic = m1 == m4
 
+        # Compression accounting straight off the catalog: payload
+        # (packed) bytes vs stored bytes — the factor the disk/link
+        # stops shipping.
+        raw_b = sum(c.payload_size(N_SAMPLES) for c in manifest.chunks)
+        stored_b = sum(c.disk_size(N_SAMPLES) for c in manifest.chunks)
+        compress_ratio = raw_b / max(stored_b, 1)
+
         st = open_store(store_dir)
         store_cold_s = _stream_s(st)   # mmap + verify + decode, serial
         store_hot_s = _stream_s(st)    # decode-cache hits
         cache = st.cache.stats()
 
-        # The same cold tier with the readahead pool armed (fresh
-        # reader: first-touch verification re-runs per reader).
-        st_ra = open_store(store_dir, readahead_chunks=4)
+        # The same cold tier with the cadence-adaptive readahead pool
+        # armed (fresh reader: first-touch verification re-runs per
+        # reader) — the production-default read configuration.
+        st_ra = open_store(store_dir, readahead_chunks=4,
+                           readahead_chunks_max=16)
         store_cold_ra_s = _stream_s(st_ra)
         st_ra.close()
+
+        # Link-bound replay: the feed-saturation claim measured end to
+        # end instead of extrapolated. Chunk STORED bytes are metered
+        # through a token-bucket link model at LINK_MB_S (a scaled
+        # stand-in for the production 1 GB/s host link — slow enough
+        # that this box's native decode is never the bottleneck, which
+        # is exactly the feed-bound regime of BENCH_r02–r05). The same
+        # cohort, compacted raw and compressed, streams through the
+        # same link: the compressed store delivers ~compress_ratio×
+        # more decoded bytes per link-second iff the native
+        # readahead-overlapped decode keeps pace with the link — the
+        # "stream at link rate, not decode rate" contract. The config-2
+        # projection then follows from MEASURED stored-bytes-per-variant
+        # × measured decode overhead, not an assumed ratio.
+        import threading
+        import types
+
+        LINK_MB_S = 25.0
+
+        def _link_stream_s(d: str) -> float:
+            st_l = open_store(d, readahead_chunks=4,
+                              readahead_chunks_max=16)
+            inner = type(st_l)._stored_bytes
+            lock = threading.Lock()
+            ship = [time.perf_counter()]
+
+            def metered(self, idx, _healed=False):
+                arr = inner(self, idx, _healed)
+                with lock:
+                    ship[0] = (max(ship[0], time.perf_counter())
+                               + arr.nbytes / (LINK_MB_S * 1e6))
+                    wait = ship[0] - time.perf_counter()
+                if wait > 0:
+                    time.sleep(wait)
+                return arr
+
+            st_l._stored_bytes = types.MethodType(metered, st_l)
+            s = _stream_s(st_l)
+            st_l.close()
+            return s
+
+        store_dir_raw = tempfile.mkdtemp(prefix="storebench_raw_",
+                                         dir=CACHE)
+        try:
+            compact(store_dir_raw, VcfSource(vcf_path),
+                    chunk_variants=STORE_BENCH_CHUNK, workers=4,
+                    codec="raw")
+            link_raw_s = _link_stream_s(store_dir_raw)
+        finally:
+            shutil.rmtree(store_dir_raw, ignore_errors=True)
+        link_zlib_s = _link_stream_s(store_dir)
+        # measured / ideal-link wall ≈ 1.0 ⇒ the feed runs at link
+        # rate with decode fully hidden behind it.
+        link_decode_overhead = link_zlib_s / (stored_b / (LINK_MB_S * 1e6))
+        config2_demo_s = (stored_b * (AUTOSOME_VARIANTS / nv) / 1e9
+                          * link_decode_overhead)
 
         # Round-trip contract: the compacted store must produce BIT-
         # identical PCoA coordinates to the direct-source run.
@@ -1055,13 +1139,24 @@ def bench_store(store: str) -> dict:
             )
 
         direct = pcoa_job(_job("vcf", vcf_path))
+        # Feed-stall fraction over the store-fed streamed job: the
+        # share of wall the staged feed spent waiting for a free slab
+        # (prefetch.stage_wait_s — producer blocked on the ring, i.e.
+        # transfer/compute-bound) — 0.0 when staging is disabled (CPU
+        # placements are zero-copy) or the feed never blocks.
+        stall0 = telemetry.histogram_sum("prefetch.stage_wait_s")
+        t0 = time.perf_counter()
         via_store = pcoa_job(_job("store", store_dir))
+        store_job_wall_s = time.perf_counter() - t0
+        feed_stall_frac = (
+            telemetry.histogram_sum("prefetch.stage_wait_s") - stall0
+        ) / max(store_job_wall_s, 1e-9)
         identical = bool(np.array_equal(direct.coords, via_store.coords))
 
         # Serve cold start: panel staged from the cold parse vs the
         # store (the `serve` process-restart cost the manifest retires).
-        model_path = os.path.join(CACHE,
-                                  f"store_bench_model_{N_SAMPLES}x{nv}.npz")
+        model_path = os.path.join(
+            CACHE, f"store_bench_sfs_model_{N_SAMPLES}x{nv}.npz")
         if not os.path.exists(model_path):
             pcoa_job(_job("store", store_dir).replace(
                 model_path=model_path))
@@ -1082,6 +1177,9 @@ def bench_store(store: str) -> dict:
     out = {
         "cohort": [N_SAMPLES, nv],
         "chunks": len(manifest.chunks),
+        "store_compress_ratio": round(compress_ratio, 2),
+        "store_stored_mb": round(stored_b / 1e6, 2),
+        "store_feed_stall_frac": round(feed_stall_frac, 4),
         "cold_parse_s": round(cold_parse_s, 3),
         "cold_parse_mb_s": round(dense_mb / cold_parse_s, 1),
         "compact_w1_s": round(compact_w1_s, 3),
@@ -1097,6 +1195,12 @@ def bench_store(store: str) -> dict:
         "store_cold_readahead_mb_s": round(dense_mb / store_cold_ra_s, 1),
         "store_cold_readahead_vs_hit": round(
             store_cold_ra_s / store_hot_s, 2),
+        "store_link_mb_s": LINK_MB_S,
+        "store_cold_link_raw_mb_s": round(dense_mb / link_raw_s, 1),
+        "store_cold_link_mb_s": round(dense_mb / link_zlib_s, 1),
+        "store_link_relief_vs_raw": round(link_raw_s / link_zlib_s, 2),
+        "store_link_decode_overhead": round(link_decode_overhead, 3),
+        "config2_demonstrated_stream_s": round(config2_demo_s, 1),
         "store_hit_s": round(store_hot_s, 3),
         "store_hit_mb_s": round(dense_mb / store_hot_s, 1),
         "store_hit_vs_cold_parse": round(speedup, 1),
@@ -1106,26 +1210,52 @@ def bench_store(store: str) -> dict:
         "serve_cold_start_store_s": round(serve_store_s, 2),
         "serve_cold_start_delta_s": round(serve_vcf_s - serve_store_s, 2),
         "note": (
+            "cohort has a realistic log-uniform-MAF site-frequency "
+            "spectrum, chunked at 2048 variants (8 chunks) so the "
+            "readahead pool has a stream to run ahead of; "
             "dense-equivalent MB/s = N*V bytes / wall-clock; store_hit "
             "is the decode-cache-resident second pass (the steady state "
             "of repeated jobs over one catalog), store_cold includes "
-            "first-touch sha256 verification of every chunk (the "
-            "_readahead variant overlaps it via the background pool); "
-            "compaction is measured at 1 and 4 ingest workers over the "
-            "same VCF, outputs required byte-identical; the round-trip "
-            "PCoA identity check runs against the 4-worker store"
+            "first-touch sha256 verification + inflate of every "
+            "compressed chunk (the _readahead variant overlaps both via "
+            "the cadence-adaptive background pool); "
+            "store_compress_ratio = packed payload bytes / stored "
+            "bytes (what the disk/link stops shipping); "
+            "store_cold_link_* stream raw vs compressed compactions of "
+            "the SAME cohort through a token-bucket link model at "
+            "store_link_mb_s (a scaled stand-in for the 1 GB/s "
+            "production link): relief_vs_raw ≈ the compression ratio "
+            "and decode_overhead ≈ 1.0 demonstrate streaming at link "
+            "rate rather than decode rate, and "
+            "config2_demonstrated_stream_s is 2504 x 40M at 1 GB/s "
+            "from the measured stored-bytes-per-variant x measured "
+            "overhead; "
+            "store_feed_stall_frac = prefetch.stage_wait_s share of "
+            "the store-fed streamed job's wall (0 when staging is "
+            "disabled on CPU placements); compaction is measured at 1 "
+            "and 4 ingest workers over the same VCF, outputs required "
+            "byte-identical; the round-trip PCoA identity check runs "
+            "against the 4-worker store"
         ),
     }
     log(f"store bench: cold VCF parse {out['cold_parse_mb_s']} MB/s, "
         f"compaction {out['compact_mb_s_w1']} MB/s @1w -> "
         f"{out['compact_mb_s_w4']} MB/s @4w "
         f"({out['compact_scaling_w4_vs_w1']}x, deterministic="
-        f"{compact_deterministic}), store cold "
-        f"{out['store_cold_mb_s']} MB/s (readahead "
+        f"{compact_deterministic}), compression "
+        f"{out['store_compress_ratio']}x ({out['store_stored_mb']} MB "
+        f"stored), store cold {out['store_cold_mb_s']} MB/s (readahead "
         f"{out['store_cold_readahead_mb_s']} MB/s, "
         f"{out['store_cold_readahead_vs_hit']}x hit), store hit "
         f"{out['store_hit_mb_s']} MB/s ({out['store_hit_vs_cold_parse']}x "
-        f"cold parse), pcoa bit-identical={identical}, serve cold-start "
+        f"cold parse), {LINK_MB_S:.0f} MB/s link-bound "
+        f"{out['store_cold_link_raw_mb_s']} -> "
+        f"{out['store_cold_link_mb_s']} MB/s decoded "
+        f"({out['store_link_relief_vs_raw']}x relief, decode overhead "
+        f"{out['store_link_decode_overhead']}x, config-2 demonstrated "
+        f"{out['config2_demonstrated_stream_s']}s @1GB/s), feed stall "
+        f"{out['store_feed_stall_frac']}, "
+        f"pcoa bit-identical={identical}, serve cold-start "
         f"{serve_vcf_s:.2f}s -> {serve_store_s:.2f}s")
     return out
 
@@ -1447,6 +1577,14 @@ def main() -> None:
         headline["store_cold_mb_s"] = configs["store"]["store_cold_mb_s"]
         headline["store_cold_readahead_mb_s"] = configs["store"][
             "store_cold_readahead_mb_s"]
+        headline["store_compress_ratio"] = configs["store"][
+            "store_compress_ratio"]
+        headline["store_feed_stall_frac"] = configs["store"][
+            "store_feed_stall_frac"]
+        headline["store_link_relief_vs_raw"] = configs["store"][
+            "store_link_relief_vs_raw"]
+        headline["config2_demonstrated_stream_s"] = configs["store"][
+            "config2_demonstrated_stream_s"]
         headline["store_serve_cold_start_delta_s"] = configs["store"][
             "serve_cold_start_delta_s"]
         headline["store_ok"] = bool(

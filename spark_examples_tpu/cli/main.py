@@ -126,11 +126,28 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "accounting; 0 disables — see README 'Dataset "
                    "store')")
     g.add_argument("--readahead-chunks", type=int, default=2,
-                   help="dataset-store readahead depth: chunks decoded "
-                   "+ digest-verified AHEAD of the streaming cursor by "
-                   "a background pool into the decode cache, so the "
-                   "store-cold tier runs at store-hit throughput "
-                   "(0 disables; see README 'Performance tuning')")
+                   help="dataset-store readahead depth FLOOR: chunks "
+                   "decoded + digest-verified AHEAD of the streaming "
+                   "cursor by a background pool into the decode cache, "
+                   "so the store-cold tier runs at store-hit "
+                   "throughput (0 disables; see README 'Performance "
+                   "tuning')")
+    g.add_argument("--readahead-chunks-max", type=int, default=16,
+                   help="cadence-adaptive readahead ceiling: the pool "
+                   "deepens from --readahead-chunks toward this when "
+                   "the measured consumer cadence outruns the "
+                   "per-chunk decode latency, and shrinks back when it "
+                   "does not (live depth = the store.readahead.depth "
+                   "gauge; 0 pins the depth at the floor)")
+    g.add_argument("--store-codec", default="zlib",
+                   metavar="{" + ",".join(config.STORE_CODEC_SPECS) + "}",
+                   help="chunk payload codec for `ingest` compactions: "
+                   "raw = uncompressed 2-bit payload, zlib = per-chunk "
+                   "deflate (deterministic, ~several-fold smaller on "
+                   "real genotypes), zlib-dict = deflate with a "
+                   "per-contig dictionary trained during compaction "
+                   "(helps small chunks); reads auto-detect per chunk "
+                   "from the manifest")
     g.add_argument("--store-replicas", nargs="*", default=[],
                    metavar="DIR",
                    help="peer store directories holding content-"
@@ -298,6 +315,8 @@ def _job_from_args(args) -> JobConfig:
             io_retry_backoff_s=args.io_retry_backoff,
             store_cache_mb=args.store_cache_mb,
             readahead_chunks=args.readahead_chunks,
+            readahead_chunks_max=args.readahead_chunks_max,
+            store_codec=args.store_codec,
         ),
         compute=ComputeConfig(
             backend=args.backend,
@@ -884,16 +903,23 @@ def _dispatch(args, parser, job, J, build_source) -> int:
         manifest = compact(job.output_path, src,
                            chunk_variants=args.chunk_variants,
                            workers=job.ingest.ingest_workers,
+                           codec=job.ingest.store_codec,
                            origin=origin_from_ingest(job.ingest,
                                                      args.chunk_variants))
         dt = _time.perf_counter() - t0
         dense_mb = manifest.n_samples * manifest.n_variants / 1e6
+        n = manifest.n_samples
+        raw_b = sum(c.payload_size(n) for c in manifest.chunks)
+        stored_b = sum(c.disk_size(n) for c in manifest.chunks)
         print(
             f"compacted {manifest.n_samples} samples x "
             f"{manifest.n_variants} variants into {len(manifest.chunks)} "
-            f"content-addressed chunks ({dense_mb / 4:.1f} MB 2-bit) -> "
-            f"{job.output_path} in {dt:.1f}s "
+            f"content-addressed chunks ({dense_mb / 4:.1f} MB 2-bit -> "
+            f"{stored_b / 1e6:.1f} MB stored, "
+            f"{raw_b / max(stored_b, 1):.2f}x {job.ingest.store_codec}) "
+            f"-> {job.output_path} in {dt:.1f}s "
             f"({dense_mb / max(dt, 1e-9):.0f} MB/s dense-equivalent, "
+            f"{stored_b / 1e6 / max(dt, 1e-9):.0f} MB/s written, "
             f"{job.ingest.ingest_workers} workers); "
             f"read it back with --source store:{job.output_path}"
         )

@@ -16,6 +16,15 @@ from __future__ import annotations
 import dataclasses
 from dataclasses import dataclass, field
 
+# Chunk-payload codec spellings of the dataset store's --store-codec
+# flag (store/codec.py consumes this tuple — config cannot import the
+# store package without a cycle): "raw" = no compression (the v1/v2
+# store format), "zlib" = per-chunk deflate at a fixed, deterministic
+# level, "zlib-dict" = deflate with a per-contig preset dictionary
+# trained during compaction. Declared here so config-time validation
+# and the codec registry can never drift apart.
+STORE_CODEC_SPECS = ("raw", "zlib", "zlib-dict")
+
 # Single source of truth for the randomized-eigh accuracy-contract
 # defaults (BASELINE.md "Randomized-solver accuracy"): the CLI flags,
 # ComputeConfig, and the library-level solver defaults (ops/eigh.py,
@@ -156,8 +165,20 @@ class IngestConfig:
     # Store readahead (store/readahead.py): chunks decoded + verified
     # AHEAD of the streaming cursor by a background pool into the
     # decode cache, turning the store-cold tier into store-hit
-    # throughput. 0 disables.
+    # throughput. 0 disables. `readahead_chunks` is the depth FLOOR;
+    # `readahead_chunks_max` is the adaptive ceiling — the pool grows
+    # the depth toward it when the measured consumer cadence outruns
+    # the measured per-chunk decode latency (EWMA of both, exported as
+    # the store.readahead.depth gauge) and shrinks back when the
+    # consumer is the bottleneck. 0 pins the depth at the floor.
     readahead_chunks: int = 2
+    readahead_chunks_max: int = 16
+    # Chunk-payload codec for `ingest` compactions (STORE_CODEC_SPECS;
+    # store/codec.py): compressed chunks shrink bytes on disk/link ~4x
+    # on real genotype data, and the native decode path inflates +
+    # unpacks in one GIL-released call. Reads auto-detect per chunk
+    # from the manifest, so this only shapes NEW compactions.
+    store_codec: str = "zlib"
     # Peer store directories holding content-addressed chunk copies
     # (store/heal.py): a chunk failing its digest verify is healed in
     # place from a replica (else from the manifest's recorded origin)
@@ -188,6 +209,27 @@ class IngestConfig:
                "sub-ranges per --references contig; 1 = off")
         _check("readahead_chunks", self.readahead_chunks, 0, 65536,
                "store chunks decoded ahead of the cursor; 0 = off")
+        _check("readahead_chunks_max", self.readahead_chunks_max, 0, 65536,
+               "cadence-adaptive readahead depth ceiling; 0 = pin the "
+               "depth at readahead_chunks")
+        if (self.readahead_chunks_max
+                and self.readahead_chunks_max < self.readahead_chunks):
+            raise ValueError(
+                f"bad ingest config: readahead_chunks_max="
+                f"{self.readahead_chunks_max} sits under "
+                f"readahead_chunks={self.readahead_chunks} — the "
+                "adaptive ceiling cannot be below the floor (raise "
+                "--readahead-chunks-max, or set it to 0 to pin the "
+                "depth)"
+            )
+        if self.store_codec not in STORE_CODEC_SPECS:
+            raise ValueError(
+                f"bad ingest config: store_codec={self.store_codec!r} — "
+                f"expected one of {' | '.join(STORE_CODEC_SPECS)} "
+                "(raw = no compression, zlib = per-chunk deflate, "
+                "zlib-dict = deflate with a per-contig dictionary "
+                "trained during compaction)"
+            )
         _check("store_cache_mb", self.store_cache_mb, 0, 1 << 20,
                "decode-cache budget in MB; 0 = no cache")
         _check("io_retries", self.io_retries, 0, 1000,

@@ -201,6 +201,25 @@ NAMES: dict[str, tuple[str, str]] = {
         "chunk warms submitted to the store readahead pool (decode + "
         "first-touch verify ahead of the streaming cursor)",
     ),
+    "store.codec.raw_bytes": (
+        "counter",
+        "packed payload bytes produced by compaction BEFORE chunk "
+        "compression (store/codec.py); raw_bytes / stored_bytes is the "
+        "store's compression ratio",
+    ),
+    "store.codec.stored_bytes": (
+        "counter",
+        "chunk bytes after compression — what compaction actually "
+        "hashes, names, and a cold read actually pulls off disk/link",
+    ),
+    "store.codec.fallback": (
+        "counter",
+        "the native decode-to-slab entry (store_decode_chunk) was "
+        "unavailable and the pure-Python chunk decode was selected "
+        "(once per process — a selection flag, not a rate): a stale "
+        "native build degrading loudly instead of silently running "
+        "the slow path",
+    ),
     "store.readahead.hits": (
         "counter",
         "consumer chunk reads served by a completed (or awaited) "
@@ -400,6 +419,16 @@ NAMES: dict[str, tuple[str, str]] = {
         "decoded dense bytes resident in the store's host-RAM decode "
         "cache (bounded by --store-cache-mb; max == the bound means "
         "the working set does not fit and evictions are live)",
+    ),
+    "store.readahead.depth": (
+        "gauge",
+        "the readahead pool's live scheduling depth: cadence-adaptive "
+        "between --readahead-chunks (floor) and --readahead-chunks-max "
+        "(ceiling) — deepened one per retire while the consumer blocks "
+        "on unfinished warms, settled toward the EWMA of per-chunk "
+        "consumer cadence vs decode latency otherwise; pinned at the "
+        "ceiling means the feed is decode/disk-bound; at the floor, "
+        "compute-bound",
     ),
     "store.readahead.in_flight": (
         "gauge",
@@ -783,6 +812,14 @@ def count(name: str, n: float = 1.0) -> float:
 def counter_value(name: str) -> float:
     with _lock:
         return _counters.get(name, 0.0)
+
+
+def histogram_sum(name: str) -> float:
+    """Sum of every value observed into histogram ``name`` (0.0 when
+    never observed) — the read bench.py's stall-fraction deltas use."""
+    with _lock:
+        h = _hists.get(name)
+        return h.sum if h is not None else 0.0
 
 
 def gauge_set(name: str, value: float) -> None:

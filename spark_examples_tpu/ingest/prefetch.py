@@ -328,6 +328,21 @@ def _produce_host_blocks(
         and hasattr(source, "packed_blocks")
         and block_variants % bitpack.VARIANTS_PER_BYTE == 0
     )
+    # Dense staged store streams skip the source's own block
+    # materialization entirely: the producer drives the store's
+    # decode_range_into against the staging slab, so a cold chunk
+    # inflates + unpacks STRAIGHT into the slab in one native call
+    # (store/codec.py) — no per-block dense buffer, no copy-to-slab.
+    # Capability-detected: StoreSource advertises it, and the retry
+    # boundary (the DEFAULT wrapper) forwards it under its own budget
+    # (ingest/resilient.py); other wrappers (filters) take the
+    # ordinary path below, bit-identically.
+    decode_direct = (
+        staging
+        and not pack
+        and hasattr(source, "decode_range_into")
+        and hasattr(source, "block_spans")
+    )
     ring = None
     if staging and not zero_copy:
         n_slots = max(1, prefetch) + TRANSFER_DEPTH + 2
@@ -378,7 +393,25 @@ def _produce_host_blocks(
 
     def produce():
         try:
-            if zero_copy:
+            if decode_direct and ring is not None:
+                if stats is not None:
+                    # Store payloads are 2-bit dosages by construction:
+                    # the dense-transport max-value guard's answer is
+                    # known without scanning a single block.
+                    stats["max_value"] = 2
+                for lo, hi, meta in source.block_spans(
+                    block_variants, start_variant
+                ):
+                    slot = ring.acquire(stop)
+                    if slot is None:
+                        return
+                    w = hi - lo
+                    source.decode_range_into(lo, hi, slot.buf)
+                    if w < slot.buf.shape[1]:
+                        slot.buf[:, w:] = MISSING
+                    if not _put((slot.buf, slot, meta)):
+                        return
+            elif zero_copy:
                 w_bytes = width // bitpack.VARIANTS_PER_BYTE
                 for pblock, meta in source.packed_blocks(
                     block_variants, start_variant

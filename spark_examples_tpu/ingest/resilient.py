@@ -115,6 +115,17 @@ class RetryingSource:
     def __post_init__(self):
         if hasattr(self.inner, "packed_blocks"):
             self.packed_blocks = self._packed_blocks
+        # The staged dense feed detects the store's decode-straight-
+        # into-slab drive by capability (ingest/prefetch.py
+        # decode_direct); the DEFAULT config wraps every store in this
+        # boundary (io_retries=3), so without forwarding, production
+        # store-fed jobs would silently demote to the materialize-then-
+        # copy path — and choosing compression would mean losing IO-
+        # retry protection.
+        if hasattr(self.inner, "decode_range_into") and hasattr(
+                self.inner, "block_spans"):
+            self.block_spans = self._block_spans
+            self.decode_range_into = self._decode_range_into
 
     @property
     def n_samples(self) -> int:
@@ -236,3 +247,58 @@ class RetryingSource:
             lambda cur: self.inner.packed_blocks(block_variants, cur),
             block_variants, start_variant, validate=False,
         )
+
+    def _block_spans(self, block_variants: int, start_variant: int = 0):
+        # Pure manifest arithmetic, no chunk IO — nothing to retry.
+        yield from self.inner.block_spans(block_variants, start_variant)
+
+    def _decode_range_into(self, lo: int, hi: int, out: np.ndarray,
+                           col_off: int = 0) -> None:
+        """One bounded decode under the retry boundary. A transient
+        error may leave ``out`` partially written; a successful retry
+        re-decodes the whole [lo, hi) span over it, so the slab leaves
+        here bit-identical to an unwrapped decode. StoreCorruptError is
+        a ValueError — quarantine semantics pass through untouched."""
+        rng = random.Random(self.seed)
+        retries_left = self.policy.max_retries
+        need_reopen = False
+        while True:
+            try:
+                # The rebuild lives INSIDE the boundary (same contract
+                # as _stream): on a still-flaky mount reopen() fails
+                # like a block read and consumes the same budget.
+                if need_reopen and self.reopen is not None:
+                    telemetry.count("ingest.reopens")
+                    self.inner = self.reopen()
+                need_reopen = False
+                # Same per-block site the streamed path fires inside
+                # its boundary: an armed kill/io_error spec hits the
+                # staged drive at the same cadence.
+                faults.fire("ingest.block_read")
+                self.inner.decode_range_into(lo, hi, out, col_off)
+                return
+            except self.policy.retry_on as e:
+                if retries_left <= 0:
+                    telemetry.count("ingest.exhausted")
+                    raise IngestExhaustedError(
+                        f"ingest failed at variant cursor {lo} after "
+                        f"{self.policy.max_retries} retries: {e!r} — "
+                        "resume from the last --checkpoint-dir "
+                        "checkpoint or restart this stream at "
+                        f"start_variant={lo}",
+                        lo,
+                    ) from e
+                attempt = self.policy.max_retries - retries_left
+                retries_left -= 1
+                delay = self.policy.sleep_s(attempt, rng)
+                telemetry.count("ingest.retries")
+                telemetry.count("ingest.backoff_s", delay)
+                warnings.warn(
+                    f"transient ingest error at variant cursor {lo} "
+                    f"({e!r}); retrying in {delay * 1e3:.0f} ms "
+                    f"({retries_left} retries left)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                time.sleep(delay)
+                need_reopen = True

@@ -73,7 +73,14 @@ def _lib_path(tag: str) -> str:
 
 
 def _build(lib_path: str, march_native: bool) -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o"]
+    # -lz: the store's compressed-chunk decode (store_decode_chunk)
+    # inflates with the same libz the Python zlib module wraps, so the
+    # two paths accept exactly the same streams.
+    # -Wl,--no-undefined: -shared happily links with unresolved symbols
+    # and defers the failure to dlopen time — which would publish a
+    # cached library that can never load; fail the BUILD instead.
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-Wl,--no-undefined",
+           _SRC, "-lz"]
     if march_native:
         cmd.insert(1, "-march=native")
     # Unique temp per process: concurrent builders (two-process
@@ -81,8 +88,8 @@ def _build(lib_path: str, march_native: bool) -> bool:
     # path another process just os.replace()d live.
     tmp = f"{lib_path}.{os.getpid()}.tmp"
     try:
-        subprocess.run(cmd + [tmp], check=True, capture_output=True,
-                       timeout=120)
+        subprocess.run(cmd + ["-o", tmp], check=True,
+                       capture_output=True, timeout=120)
         os.replace(tmp, lib_path)  # atomic publish
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
@@ -90,7 +97,7 @@ def _build(lib_path: str, march_native: bool) -> bool:
             os.unlink(tmp)
         except OSError:
             pass
-        return False
+    return False
 
 
 def load() -> ctypes.CDLL | None:
@@ -114,7 +121,19 @@ def load() -> ctypes.CDLL | None:
                 path, march_native=not tag.startswith("portable")
             ):
                 return None
-            lib = ctypes.CDLL(path)
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                # A cached library that no longer loads (e.g. published
+                # by an older builder without -Wl,--no-undefined against
+                # a since-removed dependency): rebuild once in place
+                # rather than dooming every future process to the
+                # Python fallback.
+                os.unlink(path)
+                if not _build(path,
+                              march_native=not tag.startswith("portable")):
+                    return None
+                lib = ctypes.CDLL(path)
         except OSError:
             return None
         i64, i8p, u8p, cp = (
@@ -123,18 +142,39 @@ def load() -> ctypes.CDLL | None:
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
             ctypes.c_char_p,
         )
-        lib.pack_dosages_i8.argtypes = [i8p, i64, i64, u8p]
-        lib.pack_dosages_i8.restype = ctypes.c_int
-        lib.unpack_dosages_u8.argtypes = [u8p, i64, i64, i8p]
-        lib.unpack_dosages_u8.restype = None
-        lib.vcf_parse_gt.argtypes = [cp, i64, i64, i64, i8p, i64]
-        lib.vcf_parse_gt.restype = i64
-        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
-        lib.vcf_parse_block.argtypes = [
-            cp, i64, i64, i64, i8p, i64p, i64p, i64p,
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.vcf_parse_block.restype = i64
+        try:
+            lib.pack_dosages_i8.argtypes = [i8p, i64, i64, u8p]
+            lib.pack_dosages_i8.restype = ctypes.c_int
+            lib.unpack_dosages_u8.argtypes = [u8p, i64, i64, i8p]
+            lib.unpack_dosages_u8.restype = None
+            lib.vcf_parse_gt.argtypes = [cp, i64, i64, i64, i8p, i64]
+            lib.vcf_parse_gt.restype = i64
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.vcf_parse_block.argtypes = [
+                cp, i64, i64, i64, i8p, i64p, i64p, i64p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.vcf_parse_block.restype = i64
+        except AttributeError:
+            # A library missing a MANDATORY export (a bad hand-built
+            # binary dropped into the cache path): the Python fallback,
+            # not an ImportError for every consumer.
+            return None
+        try:
+            # Raw pointers (c_void_p) rather than ndpointers: the
+            # caller hands an interior pointer (slab base + column
+            # offset) and a row stride, which ndpointer cannot express.
+            lib.store_decode_chunk.argtypes = [
+                ctypes.c_void_p, i64, ctypes.c_int32, ctypes.c_char_p,
+                i64, i64, i64, i64, i64, ctypes.c_void_p, i64,
+            ]
+            lib.store_decode_chunk.restype = ctypes.c_int
+        except AttributeError:
+            # A stale binary predating the decode-to-slab entry: the
+            # store's codec layer detects this (has_store_decode) and
+            # degrades LOUDLY to the Python path (store.codec.fallback).
+            pass
         _lib = lib
         return _lib
 
@@ -180,6 +220,51 @@ def vcf_parse_gt(line: bytes, gt_index: int, n_samples: int,
         return False
     got = lib.vcf_parse_gt(line, len(line), 9, gt_index, out, n_samples)
     return got == n_samples
+
+
+def has_store_decode() -> bool:
+    """Whether the loaded library exports the store's decode-to-slab
+    entry (False also when the library itself is unavailable). A stale
+    cached binary can lack it — the store layer then selects the
+    Python fallback and counts ``store.codec.fallback``."""
+    lib = load()
+    return lib is not None and hasattr(lib, "store_decode_chunk")
+
+
+def store_decode_chunk(stored: np.ndarray, codec_id: int,
+                       zdict: bytes | None, n: int, w_bytes: int,
+                       v0: int, v1: int, out: np.ndarray,
+                       col_off: int = 0) -> int | None:
+    """Decode variants [v0, v1) of one stored chunk into
+    ``out[:, col_off : col_off + (v1 - v0)]`` in ONE GIL-released call
+    (inflate when compressed + 2-bit unpack, no intermediate buffers).
+
+    ``stored`` is any C-contiguous uint8 buffer of the chunk file's
+    bytes (typically the verified mmap); ``out`` must be C-contiguous
+    int8 with at least ``col_off + (v1 - v0)`` columns. Returns the C
+    return code (0 = ok; nonzero = undecodable bytes, the caller's
+    corruption path), or None when the library or the symbol is
+    unavailable (caller falls back to the Python decode)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "store_decode_chunk"):
+        return None
+    stored = np.ascontiguousarray(stored, np.uint8)
+    if (out.dtype != np.int8 or out.ndim != 2
+            or not out.flags["C_CONTIGUOUS"]
+            or not out.flags["WRITEABLE"]
+            or not 0 <= col_off <= out.shape[1] - (v1 - v0)
+            or out.shape[0] < n):
+        raise ValueError(
+            "store_decode_chunk needs a writable C-contiguous int8 "
+            f"(>= {n}, >= {col_off + (v1 - v0)}) output, got "
+            f"{out.dtype} {out.shape} col_off={col_off}"
+        )
+    return lib.store_decode_chunk(
+        ctypes.c_void_p(stored.ctypes.data), stored.size,
+        int(codec_id), zdict or None, len(zdict) if zdict else 0,
+        n, w_bytes, v0, v1,
+        ctypes.c_void_p(out.ctypes.data + col_off), out.strides[0],
+    )
 
 
 def vcf_parse_block(buf: bytes, n_samples: int):

@@ -16,11 +16,15 @@
 // library is an accelerator, never a semantic fork (tests pin native ==
 // NumPy on the same inputs).
 //
-// Build: g++ -O3 -march=native -shared -fPIC codec.cpp -o libsparktpu.so
-// (spark_examples_tpu/native/__init__.py builds lazily and caches).
+// Build: g++ -O3 -march=native -shared -fPIC codec.cpp -lz -o
+// libsparktpu.so (spark_examples_tpu/native/__init__.py builds lazily
+// and caches; -lz serves the store's compressed-chunk decode).
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+
+#include <zlib.h>
 
 extern "C" {
 
@@ -66,19 +70,168 @@ int pack_dosages_i8(const int8_t* g, int64_t n, int64_t v, uint8_t* out) {
 
 // (n, w) packed uint8 -> (n, 4*w) int8 dosages; code 3 -> -1.
 void unpack_dosages_u8(const uint8_t* packed, int64_t n, int64_t w,
-                       int8_t* out) {
-    static const int8_t lut[4] = {0, 1, 2, -1};
+                       int8_t* out);  // defined after unpack_clip below
+
+// ---------------------------------------------------------------------------
+// Store chunk decode-to-slab (spark_examples_tpu/store).
+//
+// One GIL-released call from a chunk file's STORED bytes to dense int8
+// dosages written straight into a caller-provided buffer (a decode-
+// cache entry, a read_range destination, or a prefetch staging-ring
+// slab): inflate (when the chunk is compressed) + 2-bit unpack with
+// variant clipping, at an arbitrary row stride and column offset —
+// the zero-intermediate replacement for the Python hop chain
+// (decompress -> bytes object -> full-width unpack -> slice -> copy).
+
+// Unpack variants [v0, v1) of an (n, w)-byte packed payload into
+// out[i * stride + (v - v0)]; code 3 -> -1. The aligned body expands a
+// whole packed byte through a 256-entry -> 4-code table with one load
+// and one 4-byte store (vs four shift+mask+LUT round trips), which is
+// what keeps the decode memory-bound instead of ALU-bound.
+static const int8_t lut4[4] = {0, 1, 2, -1};
+
+static const uint32_t* byte_table() {
+    // C++11 magic static: the guard synchronizes the first concurrent
+    // GIL-released callers (the readahead pool's initial decodes race
+    // here) — a plain `static bool ready` flag would let one thread
+    // observe ready==true before another thread's table stores are
+    // visible and expand bytes through a half-built table.
+    struct Table {
+        uint32_t tbl[256];
+        Table() {
+            for (int b = 0; b < 256; ++b) {
+                int8_t q[4] = {lut4[b & 3], lut4[(b >> 2) & 3],
+                               lut4[(b >> 4) & 3], lut4[(b >> 6) & 3]};
+                memcpy(&tbl[b], q, 4);
+            }
+        }
+    };
+    static const Table t;
+    return t.tbl;
+}
+
+static void unpack_clip(const uint8_t* packed, int64_t n, int64_t w,
+                        int64_t v0, int64_t v1, int8_t* out,
+                        int64_t stride) {
+    const uint32_t* tbl = byte_table();
     for (int64_t i = 0; i < n; ++i) {
         const uint8_t* row = packed + i * w;
-        int8_t* orow = out + i * 4 * w;
-        for (int64_t j = 0; j < w; ++j) {
-            uint8_t b = row[j];
-            orow[4 * j + 0] = lut[b & 3];
-            orow[4 * j + 1] = lut[(b >> 2) & 3];
-            orow[4 * j + 2] = lut[(b >> 4) & 3];
-            orow[4 * j + 3] = lut[(b >> 6) & 3];
+        int8_t* orow = out + i * stride - v0;
+        int64_t j = v0;
+        for (; j < v1 && (j & 3); ++j)
+            orow[j] = lut4[(row[j >> 2] >> (2 * (j & 3))) & 3];
+        for (; j + 4 <= v1; j += 4) {               // byte-aligned body
+            uint32_t q = tbl[row[j >> 2]];
+            memcpy(orow + j, &q, 4);
+        }
+        for (; j < v1; ++j)
+            orow[j] = lut4[(row[j >> 2] >> (2 * (j & 3))) & 3];
+    }
+}
+
+void unpack_dosages_u8(const uint8_t* packed, int64_t n, int64_t w,
+                       int8_t* out) {
+    unpack_clip(packed, n, w, 0, 4 * w, out, 4 * w);
+}
+
+// Inflate `stored_len` bytes into exactly `raw_size` bytes of `raw`,
+// with an optional preset dictionary. Feeds <1 GiB windows (the
+// z_stream counters are 32-bit); once the real buffer fills, a spare
+// sink distinguishes "trailer still pending" (no further output) from
+// genuine overflow. Returns 0 ok, 2 stream error / truncation,
+// 3 size mismatch. Accepts (like the Python decompressobj reference
+// path) trailing bytes after the stream end — the sha256 verify owns
+// exact-byte integrity.
+static int inflate_all(const uint8_t* stored, int64_t stored_len,
+                       const uint8_t* dict, int64_t dict_len,
+                       uint8_t* raw, int64_t raw_size) {
+    z_stream strm;
+    memset(&strm, 0, sizeof(strm));
+    if (inflateInit2(&strm, 15) != Z_OK) return 2;
+    const int64_t kWin = 1LL << 30;
+    int64_t in_off = 0, out_done = 0;
+    uint8_t spare[64];
+    int ret = Z_OK;
+    for (;;) {
+        if (strm.avail_in == 0 && in_off < stored_len) {
+            int64_t take = stored_len - in_off;
+            if (take > kWin) take = kWin;
+            strm.next_in = const_cast<Bytef*>(stored + in_off);
+            strm.avail_in = (uInt)take;
+            in_off += take;
+        }
+        int using_spare = 0;
+        if (strm.avail_out == 0) {
+            if (out_done < raw_size) {
+                int64_t give = raw_size - out_done;
+                if (give > kWin) give = kWin;
+                strm.next_out = raw + out_done;
+                strm.avail_out = (uInt)give;
+            } else {
+                strm.next_out = spare;
+                strm.avail_out = (uInt)sizeof(spare);
+                using_spare = 1;
+            }
+        } else if (out_done >= raw_size) {
+            using_spare = 1;  // a previously-handed spare window
+        }
+        uInt before = strm.avail_out;
+        ret = inflate(&strm, Z_NO_FLUSH);
+        if (ret == Z_NEED_DICT) {
+            if (!dict || dict_len <= 0 ||
+                inflateSetDictionary(&strm, dict, (uInt)dict_len) != Z_OK) {
+                inflateEnd(&strm);
+                return 2;
+            }
+            ret = inflate(&strm, Z_NO_FLUSH);
+        }
+        uInt produced = before - strm.avail_out;
+        if (using_spare) {
+            if (produced > 0) {  // more output than the catalog says
+                inflateEnd(&strm);
+                return 3;
+            }
+        } else {
+            out_done += produced;
+        }
+        if (ret == Z_STREAM_END) break;
+        if (ret == Z_BUF_ERROR && strm.avail_in == 0 &&
+            in_off >= stored_len) {
+            inflateEnd(&strm);   // truncated stream: no input, no end
+            return 2;
+        }
+        if (ret != Z_OK && ret != Z_BUF_ERROR) {
+            inflateEnd(&strm);
+            return 2;
         }
     }
+    inflateEnd(&strm);
+    return out_done == raw_size ? 0 : 3;
+}
+
+// Decode variants [v0, v1) of one stored chunk into `out` (row stride
+// `out_stride` int8 elements; the caller points `out` at its target
+// column). codec: 0 = raw (stored bytes ARE the (n, w_bytes) payload),
+// 1 = zlib. Returns 0 ok, 1 unknown codec, 2 inflate/stream error,
+// 3 size mismatch, 4 allocation failure.
+int store_decode_chunk(const uint8_t* stored, int64_t stored_len,
+                       int32_t codec, const uint8_t* dict,
+                       int64_t dict_len, int64_t n, int64_t w_bytes,
+                       int64_t v0, int64_t v1, int8_t* out,
+                       int64_t out_stride) {
+    if (codec == 0) {
+        if (stored_len != n * w_bytes) return 3;
+        unpack_clip(stored, n, w_bytes, v0, v1, out, out_stride);
+        return 0;
+    }
+    if (codec != 1) return 1;
+    uint8_t* raw = (uint8_t*)malloc((size_t)(n * w_bytes));
+    if (!raw) return 4;
+    int rc = inflate_all(stored, stored_len, dict, dict_len, raw,
+                         n * w_bytes);
+    if (rc == 0) unpack_clip(raw, n, w_bytes, v0, v1, out, out_stride);
+    free(raw);
+    return rc;
 }
 
 // Shared sample-column scan of one record: parse `n_samples` GT

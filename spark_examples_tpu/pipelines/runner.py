@@ -212,6 +212,7 @@ def _build_raw_source(cfg: IngestConfig):
             src = open_store(cfg.path,
                              cache_bytes=cfg.store_cache_mb << 20,
                              readahead_chunks=cfg.readahead_chunks,
+                             readahead_chunks_max=cfg.readahead_chunks_max,
                              replicas=tuple(cfg.store_replicas))
             # --references answered from the catalog's position index
             # (the range-partitioner surface), no chunk touched.

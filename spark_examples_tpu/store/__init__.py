@@ -16,12 +16,16 @@ quarantine (provable under the ``store.read`` fault site).
 """
 
 from spark_examples_tpu.store.cache import DecodeCache  # noqa: F401
+from spark_examples_tpu.store.codec import (  # noqa: F401
+    StoreDecodeError,
+)
 # NOTE: the heal FUNCTION stays addressed as store.heal.heal — binding
 # it here would shadow the submodule under the same attribute name.
 from spark_examples_tpu.store.heal import (  # noqa: F401
     HealError,
     heal_chunk,
     origin_from_ingest,
+    recover_dict,
 )
 from spark_examples_tpu.store.manifest import (  # noqa: F401
     STORE_SCHEMA_VERSION,

@@ -1,13 +1,19 @@
 """Tier 2 of the store read path: a bounded host-RAM decode cache.
 
-The read path is tiered — disk (mmap of the packed chunk file, the
+The read path is tiered — disk (mmap of the stored chunk file, the
 cold tier the OS page cache sits under) → this cache (the chunk's
-DENSE int8 decode, ~4x the packed bytes) → the consumer. Decoding is
-the per-read cost the packed format trades disk/IO for; jobs that pass
-over the cohort more than once (streaming refreshes, serve panel
-staging, repeated range queries) pay it once per chunk instead of once
-per read, bounded by ``max_bytes`` so a 40M-variant store cannot eat
-the host.
+DECODED form: the dense int8 decode at ~4x the packed bytes, or — for
+compressed chunks on the packed transport — the inflated 2-bit
+payload) → the consumer. Decoding is the per-read cost the packed +
+compressed format trades disk/IO for; jobs that pass over the cohort
+more than once (streaming refreshes, serve panel staging, repeated
+range queries) pay it once per chunk instead of once per read, bounded
+by ``max_bytes`` so a 40M-variant store cannot eat the host.
+
+Accounting charges each entry at its **decoded** (in-RAM ndarray)
+size, never the on-disk chunk size: once chunks compress ~4x, a bound
+derived from stored bytes would admit ~4x the RAM it claims to — the
+``--store-cache-mb`` knob bounds what the host actually holds.
 
 Every get/put is accounted (``store.cache_hits`` / ``store.cache_misses``
 counters, ``store.cache_bytes`` gauge) so a bench or a telemetry export
@@ -27,18 +33,22 @@ from spark_examples_tpu.core import telemetry
 class DecodeCache:
     """Thread-safe byte-bounded LRU of decoded chunks.
 
-    Keys are chunk ordinals; values are the dense int8 decodes, frozen
-    (read-only) so a cached chunk handed to two consumers can never be
-    mutated under either. ``max_bytes=0`` disables storage entirely
-    (every get misses — the knob's documented "no cache" setting).
-    A single value larger than the bound is not stored (storing it
-    would immediately evict everything else for a chunk that can never
-    be joined by a second one).
+    Keys are ``(form, chunk_ordinal)`` tuples — ``("dense", i)`` for
+    int8 decodes, ``("packed", i)`` for inflated 2-bit payloads: the
+    two decoded forms of one chunk are distinct entries that must
+    never collide on a bare ordinal. Values are frozen (read-only) so
+    a cached chunk handed to two consumers can never be mutated under
+    either, and charged at ``value.nbytes`` — the decoded in-RAM size.
+    ``max_bytes=0`` disables storage entirely (every get misses — the
+    knob's documented "no cache" setting). A single value larger than
+    the bound is not stored (storing it would immediately evict
+    everything else for a chunk that can never be joined by a second
+    one).
     """
 
     def __init__(self, max_bytes: int):
         self.max_bytes = max(0, int(max_bytes))
-        self._data: OrderedDict[int, np.ndarray] = OrderedDict()
+        self._data: OrderedDict = OrderedDict()
         self._bytes = 0
         self._hits = 0
         self._misses = 0
@@ -49,7 +59,7 @@ class DecodeCache:
         with self._lock:
             return len(self._data)
 
-    def peek(self, key: int) -> np.ndarray | None:
+    def peek(self, key) -> np.ndarray | None:
         """``get`` without accounting or LRU promotion — the readahead
         pool's "already resident?" probe (a background warmer consulting
         the cache must not inflate the consumer-facing hit/miss stats
@@ -57,7 +67,7 @@ class DecodeCache:
         with self._lock:
             return self._data.get(key)
 
-    def get(self, key: int) -> np.ndarray | None:
+    def get(self, key) -> np.ndarray | None:
         with self._lock:
             value = self._data.get(key)
             if value is not None:
@@ -71,7 +81,7 @@ class DecodeCache:
             telemetry.count("store.cache_misses")
         return value
 
-    def put(self, key: int, value: np.ndarray) -> None:
+    def put(self, key, value: np.ndarray) -> None:
         if self.max_bytes == 0 or value.nbytes > self.max_bytes:
             return
         frozen = np.asarray(value)

@@ -1,0 +1,242 @@
+"""Chunk payload codecs: entropy coding between the 2-bit pack and disk.
+
+The streamed end-to-end path is feed-bound ~100-400x vs chip compute
+(BENCH_r02-r05): every byte a chunk does NOT occupy on disk is a byte
+the link never ships and the verifier never hashes. Genotype dosage
+data is extremely low-entropy (long runs of homozygous-reference
+codes), so a per-chunk deflate pass shrinks the already-4x-packed
+payload several-fold more — and the content address stays the sha256
+of the *stored* (compressed) bytes, so dedupe, quarantine, replica
+heal, and `store heal` re-verification are untouched by compression.
+
+Codec registry (per-chunk, recorded in the manifest's v3 rows):
+
+- ``raw``   — the stored bytes ARE the packed payload (v1/v2 stores,
+  and ``--store-codec raw``). Zero-copy mmap reads survive.
+- ``zlib``  — per-chunk deflate at a FIXED level/strategy (the codec
+  name pins the parameters: compression must be byte-deterministic so
+  parallel compaction, kill/resume re-compaction, and origin healing
+  all reproduce identical stored bytes). An optional preset
+  dictionary — trained during ``compact()`` from the first chunk of
+  each contig and shared by that contig's chunks (``zlib-dict``) —
+  rides along as a content-addressed ``dicts/<sha256>.zdict`` file,
+  with the digest recorded per chunk.
+
+Decode has two implementations, pinned bit-identical:
+
+- **native** — ``store_decode_chunk`` in native/codec.cpp: one
+  GIL-released C call that inflates AND 2-bit-unpacks straight into a
+  caller-provided slab (arbitrary column offset/row stride),
+  collapsing the decompress -> Python bytes -> unpack -> copy-to-slab
+  hop chain of the pure-Python route into zero intermediate buffers;
+- **Python** — :func:`decompress` + ``bitpack.unpack_dosages_np`` +
+  a slice copy. Selected when the native library (or the symbol — a
+  stale binary) is absent, counted once per process as
+  ``store.codec.fallback`` and warned about, so a build problem
+  degrades loudly instead of silently running the slow path.
+
+Corrupt compressed bytes behave exactly like corrupt raw bytes: the
+sha256 first-touch verify catches bit rot/truncation before any
+inflate runs, and an inflate/size failure that slips past a disabled
+verify raises :class:`StoreDecodeError`, which the reader routes
+through the same heal -> quarantine path as a digest mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+import zlib
+
+import numpy as np
+
+from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core.config import STORE_CODEC_SPECS
+
+RAW = "raw"
+ZLIB = "zlib"
+#: Codecs a chunk record may name. A manifest naming anything else is
+#: rejected at load time with a StoreFormatError (store/manifest.py).
+CODECS = (RAW, ZLIB)
+#: --store-codec spellings (config.STORE_CODEC_SPECS is the source of
+#: truth; "zlib-dict" = the zlib codec + the per-contig dictionary).
+SPECS = STORE_CODEC_SPECS
+DEFAULT_SPEC = ZLIB
+
+# The deflate parameters ARE part of the codec's identity: stored
+# bytes must be reproducible bit-for-bit by a later re-compaction
+# (dedupe, kill/resume idempotence, origin healing). Changing any of
+# these requires a NEW codec name, never a quiet retune.
+ZLIB_LEVEL = 6
+_ZLIB_WBITS = 15
+_ZLIB_MEMLEVEL = 8
+
+# zlib's deflate window is 32 KiB — a longer preset dictionary would
+# be silently ignored past that.
+DICT_MAX_BYTES = 32768
+DICT_DIR = "dicts"
+
+# native/codec.cpp store_decode_chunk codec ids.
+CODEC_IDS = {RAW: 0, ZLIB: 1}
+
+
+class StoreDecodeError(ValueError):
+    """Stored chunk bytes that cannot be decoded (inflate failure or a
+    decompressed size that contradicts the catalog). With verification
+    on this is unreachable for disk damage — sha256 catches it first —
+    so the reader treats it exactly like a digest mismatch: heal if a
+    route exists, else quarantine."""
+
+
+def parse_spec(spec: str) -> tuple[str, bool]:
+    """``--store-codec`` spelling -> (base codec, train per-contig
+    dictionary). Raises with the flag named (config-time convention)."""
+    if spec == "zlib-dict":
+        return ZLIB, True
+    if spec in CODECS:
+        return spec, False
+    raise ValueError(
+        f"bad ingest config: store_codec={spec!r} — expected one of "
+        f"{' | '.join(SPECS)} (raw = no compression, zlib = per-chunk "
+        "deflate, zlib-dict = deflate with a per-contig dictionary "
+        "trained during compaction)"
+    )
+
+
+def train_dict(raw: bytes) -> bytes:
+    """Deterministic preset dictionary from a contig's first chunk's
+    packed payload: its trailing window (deflate scores matches near
+    the dictionary's END highest, and any slice of real genotype rows
+    is representative). Pure function of the bytes — a re-compaction
+    or an origin heal re-derives the identical dictionary."""
+    return bytes(raw[-DICT_MAX_BYTES:])
+
+
+def dict_path(root: str, digest: str) -> str:
+    return os.path.join(root, DICT_DIR, f"{digest}.zdict")
+
+
+def compress(codec: str, raw: bytes, zdict: bytes | None = None) -> bytes:
+    """Packed payload -> stored bytes (identity for ``raw``)."""
+    if codec == RAW:
+        return raw
+    if codec == ZLIB:
+        if zdict:
+            c = zlib.compressobj(ZLIB_LEVEL, zlib.DEFLATED, _ZLIB_WBITS,
+                                 _ZLIB_MEMLEVEL, zlib.Z_DEFAULT_STRATEGY,
+                                 zdict)
+        else:
+            c = zlib.compressobj(ZLIB_LEVEL, zlib.DEFLATED, _ZLIB_WBITS,
+                                 _ZLIB_MEMLEVEL, zlib.Z_DEFAULT_STRATEGY)
+        return c.compress(raw) + c.flush()
+    raise ValueError(f"unknown store codec {codec!r}")
+
+
+def decompress(codec: str, stored, raw_size: int,
+               zdict: bytes | None = None) -> bytes:
+    """Stored bytes -> packed payload (the Python reference path; the
+    zlib module wraps the same libz the native entry links, so the two
+    accept exactly the same streams)."""
+    if codec == RAW:
+        data = bytes(stored)
+        if len(data) != raw_size:
+            raise StoreDecodeError(
+                f"raw chunk payload is {len(data)} bytes, catalog says "
+                f"{raw_size}"
+            )
+        return data
+    if codec == ZLIB:
+        d = (zlib.decompressobj(_ZLIB_WBITS, zdict=zdict) if zdict
+             else zlib.decompressobj(_ZLIB_WBITS))
+        try:
+            out = d.decompress(bytes(stored), raw_size + 1)
+            out += d.flush()
+        except zlib.error as e:
+            raise StoreDecodeError(
+                f"zlib inflate failed ({e}) — stored bytes are not a "
+                "valid deflate stream for this chunk"
+            ) from None
+        if len(out) != raw_size or not d.eof:
+            raise StoreDecodeError(
+                f"zlib chunk decompressed to {len(out)} bytes "
+                f"(eof={d.eof}), catalog says {raw_size}"
+            )
+        return out
+    raise ValueError(f"unknown store codec {codec!r}")
+
+
+# ---------------------------------------------------------------------------
+# Decode-to-slab: one call from stored bytes to dense dosages.
+
+_fallback_lock = threading.Lock()
+_fallback_warned = False
+
+
+def _note_fallback() -> None:
+    """The Python decode path was selected because the native entry is
+    unavailable: count it (once — `store.codec.fallback` is a selection
+    flag, not a per-call rate) and warn once per process, EXCEPT under
+    SPARK_TPU_NO_NATIVE, where the fallback is a deliberate test pin."""
+    global _fallback_warned
+    with _fallback_lock:
+        # check-then-count must sit under the lock: the readahead
+        # pool's first decodes land here concurrently, and two threads
+        # passing the ==0 check would break the once-per-process flag.
+        if telemetry.counter_value("store.codec.fallback") == 0:
+            telemetry.count("store.codec.fallback")
+        if os.environ.get("SPARK_TPU_NO_NATIVE") or _fallback_warned:
+            return
+        _fallback_warned = True
+    warnings.warn(
+        "store: native decode-to-slab entry (store_decode_chunk) is "
+        "unavailable — a stale libsparktpu build or no g++; store reads "
+        "run the pure-Python decode path (bit-identical, measurably "
+        "slower). Rebuild the native library to restore the fast path.",
+        RuntimeWarning, stacklevel=3,
+    )
+
+
+def native_decode_available() -> bool:
+    from spark_examples_tpu import native
+
+    return native.has_store_decode()
+
+
+def decode_into(stored, codec: str, zdict: bytes | None, n: int,
+                w_bytes: int, v0: int, v1: int, out: np.ndarray,
+                col_off: int = 0) -> None:
+    """Decode variants ``[v0, v1)`` of one stored chunk into
+    ``out[:, col_off : col_off + (v1 - v0)]``.
+
+    ``stored`` is the chunk file's bytes (any uint8 buffer — typically
+    the verified mmap); ``n`` x ``w_bytes`` is the packed payload
+    geometry from the catalog. ``out`` must be C-contiguous int8 with
+    at least ``col_off + (v1 - v0)`` columns — a decode-cache entry, a
+    read_range destination, or a prefetch staging-ring slab. Native
+    when available (one GIL-released decompress+unpack, no
+    intermediate buffers), Python otherwise — bit-identical either
+    way. Raises :class:`StoreDecodeError` on undecodable bytes."""
+    from spark_examples_tpu import native
+
+    rc = native.store_decode_chunk(stored, CODEC_IDS[codec], zdict,
+                                   n, w_bytes, v0, v1, out, col_off)
+    if rc is None:
+        _note_fallback()
+        payload = decompress(codec, stored, n * w_bytes, zdict)
+        from spark_examples_tpu.ingest import bitpack
+
+        dense = bitpack.unpack_dosages_np(
+            np.frombuffer(payload, np.uint8).reshape(n, w_bytes)
+        )
+        out[:, col_off:col_off + (v1 - v0)] = dense[:, v0:v1]
+        return
+    if rc:
+        raise StoreDecodeError({
+            1: f"native decode: unknown codec id for {codec!r}",
+            2: "native decode: zlib inflate failed — stored bytes are "
+               "not a valid deflate stream for this chunk",
+            3: "native decode: decompressed size contradicts the "
+               "catalog geometry",
+            4: "native decode: payload buffer allocation failed",
+        }.get(rc, f"native decode failed (rc={rc})"))

@@ -31,6 +31,7 @@ import shutil
 import threading
 
 from spark_examples_tpu.core import hashing, telemetry
+from spark_examples_tpu.store import codec as codecmod
 from spark_examples_tpu.store import quarantine
 from spark_examples_tpu.store.manifest import ChunkRecord, StoreManifest
 
@@ -77,9 +78,11 @@ def build_origin_source(origin: dict):
     return build_source(IngestConfig(**kw))
 
 
-def _rebuild_from_origin(rec: ChunkRecord, origin: dict, source=None) -> bytes:
-    """Re-compact one chunk span from the origin stream; the caller
-    verifies the digest before installing the bytes."""
+def _raw_span_from_origin(rec: ChunkRecord, origin: dict,
+                          source=None) -> bytes:
+    """Re-compact one chunk span from the origin stream into its RAW
+    packed payload (pre-compression); the caller re-compresses with the
+    chunk's recorded codec and verifies the digest before installing."""
     import numpy as np
 
     from spark_examples_tpu.ingest import bitpack
@@ -100,6 +103,95 @@ def _rebuild_from_origin(rec: ChunkRecord, origin: dict, source=None) -> bytes:
         f"origin stream is shorter than the catalog (no block at "
         f"variant {rec.start}) — the origin changed since compaction"
     )
+
+
+def _dict_trainer_record(manifest: StoreManifest,
+                         dict_digest: str) -> ChunkRecord:
+    """The chunk that trained ``dict_digest``: the FIRST chunk (stream
+    order) carrying that digest — by the writer's construction, the
+    first chunk of the dictionary's contig (store/writer.py
+    _tag_first_of_contig)."""
+    for rec in manifest.chunks:
+        if rec.dict_digest == dict_digest:
+            return rec
+    raise HealError(
+        f"dictionary {dict_digest[:16]}... is not referenced by any "
+        "catalog row — a stale dicts/ file, nothing to rebuild"
+    )
+
+
+def recover_dict(root: str, manifest: StoreManifest, dict_digest: str,
+                 replicas=(), origin_source=None) -> bytes:
+    """Recover a missing/corrupt ``dicts/<digest>.zdict`` file in
+    place: a digest-verified copy from a replica, else re-derivation
+    from the origin (the dictionary is a pure function of its trainer
+    chunk's raw payload — store/codec.py train_dict). Returns the
+    dictionary bytes; raises :class:`HealError` when no route works."""
+    errors: list[str] = []
+    data = None
+    for rep in replicas:
+        cand = codecmod.dict_path(rep, dict_digest)
+        try:
+            with open(cand, "rb") as f:
+                got = f.read()
+        except OSError as e:
+            errors.append(f"replica {rep!r}: {e}")
+            continue
+        if hashing.sha256_bytes(got) == dict_digest:
+            data = got
+            break
+        errors.append(f"replica {rep!r}: dictionary bytes do not hash "
+                      "to the content address")
+    if data is None:
+        if manifest.origin is None:
+            raise HealError(
+                "no replica holds the dictionary and the manifest "
+                "records no origin"
+                + (": " + "; ".join(errors) if errors else "")
+            )
+        trainer = _dict_trainer_record(manifest, dict_digest)
+        try:
+            raw = _raw_span_from_origin(trainer, manifest.origin,
+                                        source=origin_source)
+        except (OSError, ValueError) as e:
+            raise HealError(
+                f"origin re-derivation of the dictionary failed: {e}"
+                + ("; " + "; ".join(errors) if errors else "")
+            ) from e
+        data = codecmod.train_dict(raw)
+        if hashing.sha256_bytes(data) != dict_digest:
+            raise HealError(
+                "re-derived dictionary does not hash to "
+                f"{dict_digest[:16]}... — the origin changed since "
+                "compaction; re-compact the store"
+            )
+    path = codecmod.dict_path(root, dict_digest)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".heal.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return data
+
+
+def _dict_bytes_for_heal(root: str, manifest: StoreManifest,
+                         rec: ChunkRecord, replicas=(),
+                         origin_source=None) -> bytes | None:
+    """The dictionary an origin re-compression of ``rec`` needs —
+    loaded from the store (digest-verified), else recovered through
+    :func:`recover_dict`."""
+    if rec.dict_digest is None:
+        return None
+    path = codecmod.dict_path(root, rec.dict_digest)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        if hashing.sha256_bytes(data) == rec.dict_digest:
+            return data
+    except OSError:
+        pass
+    return recover_dict(root, manifest, rec.dict_digest,
+                        replicas=replicas, origin_source=origin_source)
 
 
 def _install(root: str, rec: ChunkRecord, data: bytes, how: str) -> None:
@@ -146,8 +238,17 @@ def heal_chunk(root: str, manifest: StoreManifest, rec: ChunkRecord,
                     + (": " + "; ".join(errors) if errors else "")
                 )
             try:
-                data = _rebuild_from_origin(rec, manifest.origin,
+                raw = _raw_span_from_origin(rec, manifest.origin,
                                             source=origin_source)
+                # Re-compression with the chunk's recorded codec and
+                # dictionary: the codec is byte-deterministic by
+                # contract, so the stored bytes — and therefore the
+                # digest _install checks — reproduce exactly.
+                data = codecmod.compress(
+                    rec.codec, raw,
+                    _dict_bytes_for_heal(root, manifest, rec,
+                                         replicas=replicas,
+                                         origin_source=origin_source))
                 _install(root, rec, data, how="origin re-compaction")
             except (OSError, ValueError) as e:
                 raise HealError(

@@ -35,9 +35,14 @@ from spark_examples_tpu.ingest import bitpack
 # Bump when a field is added/renamed/re-semanticized. Version 2 added
 # the optional ``origin`` record (how the store was compacted — the
 # self-healing recipe); version-1 manifests load fine with origin=None.
-# load() refuses files from NEWER builds and files without a version
-# rather than guessing.
-STORE_SCHEMA_VERSION = 2
+# Version 3 added per-chunk payload codecs (store/codec.py): chunk
+# rows grew codec / raw_size / stored_size / dict_digest columns, and
+# the content address became the sha256 of the STORED (possibly
+# compressed) bytes — which for v1/v2 rows (codec "raw") is the same
+# bytes it always was, so older stores read back untouched. load()
+# refuses files from NEWER builds, files without a version, and chunk
+# rows naming a codec this build does not know, rather than guessing.
+STORE_SCHEMA_VERSION = 3
 
 MANIFEST_NAME = "manifest.json"
 CHUNK_DIR = "chunks"
@@ -72,7 +77,14 @@ class ChunkRecord:
     """One chunk's catalog row: where its variants sit in the global
     order (``[start, stop)``), which contig they belong to (chunks never
     span one), the position range they cover (-1 when the source carried
-    none), and the sha256 content address of its packed bytes."""
+    none), the sha256 content address of its STORED bytes, and how the
+    stored bytes encode the packed payload: ``codec`` (store/codec.py),
+    ``raw_size`` (packed payload bytes — redundant with the geometry,
+    recorded as a decode cross-check), ``stored_size`` (on-disk bytes;
+    the truncation check compression took away from the mmap shape),
+    and ``dict_digest`` (the shared preset dictionary, when one was
+    trained). v1/v2 rows load as codec "raw" with sizes derived from
+    the geometry — stored bytes == packed payload, as always."""
 
     start: int
     stop: int
@@ -80,13 +92,27 @@ class ChunkRecord:
     digest: str
     pos_lo: int = -1
     pos_hi: int = -1
+    codec: str = "raw"
+    raw_size: int = -1       # -1 = derive from geometry (v1/v2 rows)
+    stored_size: int = -1    # -1 = raw_size (uncompressed)
+    dict_digest: str | None = None
 
     @property
     def width(self) -> int:
         return self.stop - self.start
 
     def n_bytes(self, n_samples: int) -> int:
+        """Packed payload bytes (the decoded-from-disk size)."""
         return n_samples * bitpack.packed_width(self.width)
+
+    def payload_size(self, n_samples: int) -> int:
+        return self.raw_size if self.raw_size >= 0 else self.n_bytes(n_samples)
+
+    def disk_size(self, n_samples: int) -> int:
+        """Expected on-disk size of the stored chunk file."""
+        if self.stored_size >= 0:
+            return self.stored_size
+        return self.payload_size(n_samples)
 
     def filename(self) -> str:
         return os.path.join(CHUNK_DIR, f"{self.digest}.bin")
@@ -173,7 +199,8 @@ class StoreManifest:
             "positions_digest": self.positions_digest,
             "origin": self.origin,
             "chunks": [
-                [c.start, c.stop, c.contig, c.digest, c.pos_lo, c.pos_hi]
+                [c.start, c.stop, c.contig, c.digest, c.pos_lo, c.pos_hi,
+                 c.codec, c.raw_size, c.stored_size, c.dict_digest]
                 for c in self.chunks
             ],
         }
@@ -205,14 +232,38 @@ class StoreManifest:
         )
         version = raw["schema_version"]
         try:
-            chunks = [
-                ChunkRecord(int(s), int(t), c, d, int(pl), int(ph))
-                for s, t, c, d, pl, ph in raw["chunks"]
-            ]
+            chunks = []
+            for row in raw["chunks"]:
+                if len(row) == 6:  # v1/v2 rows: stored bytes == payload
+                    s, t, c, d, pl, ph = row
+                    chunks.append(ChunkRecord(int(s), int(t), c, d,
+                                              int(pl), int(ph)))
+                else:
+                    s, t, c, d, pl, ph, codec, rs, ss, dd = row
+                    chunks.append(ChunkRecord(
+                        int(s), int(t), c, d, int(pl), int(ph),
+                        codec=str(codec), raw_size=int(rs),
+                        stored_size=int(ss), dict_digest=dd,
+                    ))
         except (TypeError, ValueError) as e:
             raise StoreFormatError(
                 f"store manifest {path!r}: malformed chunk record ({e})"
             ) from None
+        # Unknown-codec rejection belongs HERE, not at first read: a
+        # store written by a newer build with a codec this build cannot
+        # inflate must fail like a future schema — loudly, up front —
+        # never as a mid-stream decode error at chunk 40 000.
+        from spark_examples_tpu.store.codec import CODECS
+
+        for i, c in enumerate(chunks):
+            if c.codec not in CODECS:
+                raise StoreFormatError(
+                    f"store manifest {path!r}: chunk {i} uses unknown "
+                    f"codec {c.codec!r} (this build decodes "
+                    f"{' / '.join(CODECS)}) — the store was written by "
+                    "a newer build; upgrade, or re-compact with a "
+                    "supported --store-codec"
+                )
         return cls(
             n_samples=int(raw["n_samples"]),
             n_variants=int(raw["n_variants"]),

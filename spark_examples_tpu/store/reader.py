@@ -2,23 +2,36 @@
 
 The read path is tiered:
 
-1. **disk** — each chunk file is ``np.memmap``-ed read-only (zero-copy:
-   the packed bytes page in on demand and a packed-transport consumer
-   ships slices of the mapping straight to ``device_put``);
-2. **decode cache** — dense int8 decodes of hot chunks, bounded host
-   RAM with hit/miss accounting (store/cache.py);
+1. **disk** — each chunk file is ``np.memmap``-ed read-only (the packed
+   bytes of a raw-codec chunk page in on demand and ship zero-copy; a
+   compressed chunk's stored bytes are inflated through the native
+   decode below);
+2. **decode cache** — decoded chunks in bounded host RAM with hit/miss
+   accounting (store/cache.py), charged at their DECODED size: dense
+   int8 decodes under ``("dense", idx)`` keys, and — for compressed
+   chunks on the packed transport — inflated 2-bit payloads under
+   ``("packed", idx)``;
 3. the consumer: ``blocks`` / ``packed_blocks`` re-grid chunks into any
    requested block width (never spanning a contig), ``range_source``
    answers contig/variant/position range queries off the catalog, and
    cursors resume deterministically — the drop-in contract every job
    surface (runner, streaming, serve staging) already assumes.
 
-**Integrity**: a chunk's filename is its sha256. On first touch per
-reader the bytes are re-hashed against the address (``store.read``
-fault site fires first, so the chaos harness can corrupt or fail the
-read deterministically). A mismatch or truncation first attempts an
-in-place **heal** (store/heal.py): a verified copy from a peer replica
-directory, else a re-compaction of the chunk's origin span when the
+Decoding is one native call where it matters (store/codec.py
+``decode_into``): inflate + 2-bit unpack straight into the destination
+buffer — a fresh cache entry, a ``read_range`` output, or (via
+``decode_range_into`` / ``block_spans``, the prefetch staging ring's
+direct drive) a reusable staging slab — with a bit-identical Python
+fallback that degrades loudly (``store.codec.fallback``).
+
+**Integrity**: a chunk's filename is the sha256 of its STORED bytes.
+On first touch per reader the file is re-hashed against the address
+(``store.read`` fault site fires first, so the chaos harness can
+corrupt or fail the read deterministically) — corrupt compressed bytes
+are caught exactly where corrupt raw bytes are. A mismatch, a wrong
+size, or undecodable stored bytes first attempt an in-place **heal**
+(store/heal.py): a verified copy from a peer replica directory, else a
+re-compaction (and re-compression) of the chunk's origin span when the
 manifest records one — degradation instead of fail-fast. Only when no
 route repairs it is the chunk quarantined — recorded in
 ``<store>/quarantine.json`` (atomic, idempotent — store/quarantine.py),
@@ -39,9 +52,10 @@ import numpy as np
 from spark_examples_tpu.core import faults, hashing, telemetry
 from spark_examples_tpu.ingest import bitpack
 from spark_examples_tpu.ingest.source import BlockMeta
+from spark_examples_tpu.store import codec as codecmod
 from spark_examples_tpu.store import quarantine as qledger
 from spark_examples_tpu.store.cache import DecodeCache
-from spark_examples_tpu.store.heal import HealError, heal_chunk
+from spark_examples_tpu.store.heal import HealError, heal_chunk, recover_dict
 from spark_examples_tpu.store.manifest import (
     ChunkRecord,
     StoreCorruptError,
@@ -49,19 +63,25 @@ from spark_examples_tpu.store.manifest import (
 )
 
 DEFAULT_CACHE_BYTES = 256 << 20  # 256 MB of decoded chunks
+DEFAULT_READAHEAD_MAX = 16
 
 
 def open_store(path: str, cache_bytes: int = DEFAULT_CACHE_BYTES,
                verify: bool = True,
                readahead_chunks: int = 0,
+               readahead_chunks_max: int = DEFAULT_READAHEAD_MAX,
                replicas=(), auto_heal: bool = True) -> "StoreSource":
     """Open a compacted store (manifest load + lazy chunk mapping).
 
     ``readahead_chunks > 0`` arms the background readahead pool
-    (store/readahead.py): the streaming loops warm that many chunks
-    ahead of the cursor into the decode cache, so the store-cold tier
-    (mmap + first-touch verify + decode) overlaps consumption instead
-    of serializing in front of it.
+    (store/readahead.py): the streaming loops warm chunks ahead of the
+    cursor into the decode cache, so the store-cold tier (mmap +
+    first-touch verify + decode) overlaps consumption instead of
+    serializing in front of it. ``readahead_chunks`` is the depth
+    FLOOR; ``readahead_chunks_max`` (when > floor) lets the pool adapt
+    the depth to the measured consumer cadence vs decode latency —
+    deep when the consumer outruns the decode, shallow when it does
+    not (exported as the ``store.readahead.depth`` gauge).
 
     ``replicas`` names peer store directories holding content-addressed
     copies of the chunks; together with ``auto_heal`` (default on) a
@@ -72,6 +92,7 @@ def open_store(path: str, cache_bytes: int = DEFAULT_CACHE_BYTES,
     return StoreSource(path, StoreManifest.load(path),
                        cache_bytes=cache_bytes, verify=verify,
                        readahead_chunks=readahead_chunks,
+                       readahead_chunks_max=readahead_chunks_max,
                        replicas=replicas, auto_heal=auto_heal)
 
 
@@ -82,6 +103,7 @@ class StoreSource:
     def __init__(self, root: str, manifest: StoreManifest,
                  cache_bytes: int = DEFAULT_CACHE_BYTES,
                  verify: bool = True, readahead_chunks: int = 0,
+                 readahead_chunks_max: int = DEFAULT_READAHEAD_MAX,
                  replicas=(), auto_heal: bool = True):
         self.root = root
         self.manifest = manifest
@@ -91,6 +113,7 @@ class StoreSource:
         self.cache = DecodeCache(cache_bytes)
         self._verified: set[int] = set()
         self._positions: np.ndarray | None = None
+        self._dicts: dict[str, bytes] = {}
         self._ra = None
         if readahead_chunks:
             if readahead_chunks < 0:
@@ -99,7 +122,8 @@ class StoreSource:
                 )
             from spark_examples_tpu.store.readahead import ReadaheadPool
 
-            self._ra = ReadaheadPool(readahead_chunks)
+            self._ra = ReadaheadPool(readahead_chunks,
+                                     max_depth=readahead_chunks_max)
 
     def close(self) -> None:
         """Stop the readahead pool (idempotent; streams already yielded
@@ -155,15 +179,17 @@ class StoreSource:
     def _chunk_path(self, rec: ChunkRecord) -> str:
         return os.path.join(self.root, rec.filename())
 
-    def _damaged(self, idx: int, rec: ChunkRecord, reason: str,
-                 healed: bool) -> np.ndarray:
-        """A chunk failed its size/existence/digest check: try an
+    def _handle_damage(self, idx: int, rec: ChunkRecord, reason: str,
+                       healed: bool) -> None:
+        """A chunk failed a size/existence/digest/decode check: try an
         in-place heal first (replica copy, else origin re-compaction —
         store/heal.py), and only quarantine + fail when no route
-        repairs it. ``healed`` guards the retry: a chunk that fails its
-        check AGAIN right after a successful heal is damage the heal
-        cannot fix (e.g. a fault spec re-corrupting every read), and
-        must fail rather than loop."""
+        repairs it. Returns (with the file repaired and the chunk's
+        first-touch verification reset) so the caller can retry its
+        read ONCE; ``healed`` guards that retry — a chunk that fails
+        again right after a successful heal is damage the heal cannot
+        fix (e.g. a fault spec re-corrupting every read), and must
+        fail rather than loop."""
         telemetry.count("store.verify_failures")
         if self.auto_heal and not healed and (
             self.replicas or self.manifest.origin is not None
@@ -178,10 +204,10 @@ class StoreSource:
                     f"store: chunk {idx} ({rec.digest[:16]}...) was "
                     f"corrupt ({reason}) and healed in place from "
                     f"{how} — the stream continues",
-                    RuntimeWarning, stacklevel=4,
+                    RuntimeWarning, stacklevel=5,
                 )
                 self._verified.discard(idx)
-                return self._chunk_bytes(idx, _healed=True)
+                return
         self._quarantine(idx, rec, reason)
 
     def _quarantine(self, idx: int, rec: ChunkRecord, reason: str):
@@ -211,10 +237,12 @@ class StoreSource:
             rec.start,
         )
 
-    def _chunk_bytes(self, idx: int, _healed: bool = False) -> np.ndarray:
-        """The chunk's packed bytes, mapped and (first touch) verified.
-        Damage on any check routes through :meth:`_damaged` — one heal
-        attempt, then quarantine + fail."""
+    def _stored_bytes(self, idx: int, _healed: bool = False) -> np.ndarray:
+        """The chunk file's STORED bytes (1-D uint8 mmap), size-checked
+        against the catalog and (first touch) sha256-verified against
+        the content address. Damage routes through
+        :meth:`_handle_damage` — one heal attempt, then quarantine +
+        fail."""
         rec = self.manifest.chunks[idx]
         path = self._chunk_path(rec)
         # Chaos site BEFORE the mapping: an armed truncate corrupts the
@@ -222,15 +250,9 @@ class StoreSource:
         # replica copy looks like); an io_error exercises the retry
         # boundary wrapping this source.
         faults.fire("store.read", path=path)
-        w_bytes = bitpack.packed_width(rec.width)
+        want = rec.disk_size(self.n_samples)
         try:
-            m = np.memmap(path, dtype=np.uint8, mode="r",
-                          shape=(self.n_samples, w_bytes))
-        except ValueError as e:
-            # Wrong file size for the catalog shape = truncation.
-            return self._damaged(
-                idx, rec, f"wrong size for ({self.n_samples}, "
-                f"{w_bytes}) bytes ({e})", _healed)
+            size = os.path.getsize(path)
         except FileNotFoundError:
             # A cataloged chunk that does not exist is damage (a lost
             # replica copy, a deleted quarantined file), not weather —
@@ -238,18 +260,99 @@ class StoreSource:
             # layer's whole reopen budget re-missing the same file and
             # end with no recovery guidance. Other OSErrors (EIO, a
             # flapping mount) stay retryable.
-            return self._damaged(idx, rec, "chunk file missing", _healed)
+            self._handle_damage(idx, rec, "chunk file missing", _healed)
+            return self._stored_bytes(idx, _healed=True)
+        if size != want:
+            # Wrong on-disk size for the catalog row = truncation (the
+            # check the raw mmap shape used to provide, kept explicit
+            # now that compressed sizes are per-chunk).
+            self._handle_damage(
+                idx, rec, f"file is {size} bytes, catalog says {want}",
+                _healed)
+            return self._stored_bytes(idx, _healed=True)
+        m = np.memmap(path, dtype=np.uint8, mode="r")
         if self.verify and idx not in self._verified:
             got = hashing.sha256_bytes(m)
             telemetry.count("store.chunks_verified")
             if got != rec.digest:
                 # Release the mapping before a heal rewrites the file.
                 del m
-                return self._damaged(
+                self._handle_damage(
                     idx, rec, f"sha256 {got[:16]}... does not match the "
                     "content address (bit rot or a torn write)", _healed)
+                return self._stored_bytes(idx, _healed=True)
             self._verified.add(idx)
         return m
+
+    def _dict_bytes(self, rec: ChunkRecord) -> bytes | None:
+        """The chunk's shared preset dictionary (dicts/<digest>.zdict),
+        digest-verified on first load per reader and cached. A missing
+        or corrupt dictionary file is store damage: recovered through
+        the same replica/origin routes as a chunk (store/heal.py
+        recover_dict), else failed fast with the chunk's cursor."""
+        dd = rec.dict_digest
+        if dd is None:
+            return None
+        cached = self._dicts.get(dd)
+        if cached is not None:
+            return cached
+        path = codecmod.dict_path(self.root, dd)
+        data = None
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            if hashing.sha256_bytes(data) != dd:
+                data = None
+        except OSError:
+            data = None
+        if data is None:
+            if not self.auto_heal:
+                # Same contract as chunks: with healing disabled,
+                # damage fails fast instead of quietly rewriting store
+                # files the caller said not to touch.
+                raise StoreCorruptError(
+                    f"store dictionary {path!r} is missing or corrupt "
+                    "(healing disabled) — restore the file from a "
+                    "replica or run `store heal`, then resume from "
+                    f"start_variant={rec.start}",
+                    rec.start,
+                )
+            try:
+                data = recover_dict(self.root, self.manifest, dd,
+                                    replicas=self.replicas)
+            except HealError as e:
+                raise StoreCorruptError(
+                    f"store dictionary {path!r} is missing or corrupt "
+                    f"and could not be recovered ({e}) — every chunk "
+                    "compressed against it is unreadable; restore the "
+                    "file from a replica or re-run `store heal`, then "
+                    f"resume from start_variant={rec.start}",
+                    rec.start,
+                ) from e
+        self._dicts[dd] = data
+        return data
+
+    def _decode_span_into(self, idx: int, v0: int, v1: int,
+                          out: np.ndarray, col_off: int,
+                          _healed: bool = False) -> None:
+        """Decode variants [v0, v1) of chunk ``idx`` into ``out`` at
+        ``col_off`` — the native (or fallback) decode with the same
+        one-heal-then-quarantine damage contract as the byte reads."""
+        rec = self.manifest.chunks[idx]
+        m = self._stored_bytes(idx, _healed)
+        try:
+            codecmod.decode_into(
+                m, rec.codec, self._dict_bytes(rec), self.n_samples,
+                bitpack.packed_width(rec.width), v0, v1, out, col_off,
+            )
+        except codecmod.StoreDecodeError as e:
+            # Undecodable stored bytes behave exactly like a digest
+            # mismatch (they can only diverge when verification is
+            # off or the damage landed mid-read).
+            del m
+            self._handle_damage(idx, rec, str(e), _healed)
+            self._decode_span_into(idx, v0, v1, out, col_off,
+                                   _healed=True)
 
     def _decode_chunk(self, idx: int) -> np.ndarray:
         """Unconditional map+verify+decode of one chunk into the cache —
@@ -257,40 +360,94 @@ class StoreSource:
         readahead workers (who run it off the critical path)."""
         rec = self.manifest.chunks[idx]
         with telemetry.span("store.chunk_read", cat="store", chunk=idx):
-            raw = self._chunk_bytes(idx)
-            dense = bitpack.unpack_dosages_np(raw)[:, :rec.width]
-        self.cache.put(idx, dense)
+            dense = np.empty((self.n_samples, rec.width), np.int8)
+            self._decode_span_into(idx, 0, rec.width, dense, 0)
+        self.cache.put(("dense", idx), dense)
         return dense
 
-    def _warm_dense(self, idx: int) -> np.ndarray:
-        """Readahead worker body: decode unless already resident (peek —
+    def _decompress_payload(self, idx: int,
+                            _healed: bool = False) -> np.ndarray:
+        """Inflate a compressed chunk's 2-bit payload into host RAM
+        (and the decode cache — charged at its DECODED size): the
+        packed transport's unit for non-raw chunks."""
+        rec = self.manifest.chunks[idx]
+        m = self._stored_bytes(idx, _healed)
+        try:
+            payload = codecmod.decompress(
+                rec.codec, m, rec.payload_size(self.n_samples),
+                self._dict_bytes(rec))
+        except codecmod.StoreDecodeError as e:
+            del m
+            self._handle_damage(idx, rec, str(e), _healed)
+            return self._decompress_payload(idx, _healed=True)
+        arr = np.frombuffer(payload, np.uint8).reshape(
+            self.n_samples, bitpack.packed_width(rec.width))
+        self.cache.put(("packed", idx), arr)
+        return arr
+
+    def _payload(self, idx: int) -> np.ndarray:
+        """The chunk's packed 2-bit payload, (n, w_bytes) uint8: the
+        verified mmap itself for raw chunks (zero-copy), the cached
+        inflate for compressed ones."""
+        rec = self.manifest.chunks[idx]
+        if rec.codec == codecmod.RAW:
+            return self._stored_bytes(idx).reshape(
+                self.n_samples, bitpack.packed_width(rec.width))
+        cached = self.cache.get(("packed", idx))
+        if cached is not None:
+            return cached
+        return self._decompress_payload(idx)
+
+    def _warm_payload(self, idx: int) -> np.ndarray:
+        """Readahead worker body, packed transport: verify (raw) or
+        inflate-and-cache (compressed) unless already resident (peek —
         a background warmer must not touch the consumer-facing hit/miss
         accounting)."""
-        cached = self.cache.peek(idx)
+        rec = self.manifest.chunks[idx]
+        if rec.codec == codecmod.RAW:
+            return self._stored_bytes(idx).reshape(
+                self.n_samples, bitpack.packed_width(rec.width))
+        cached = self.cache.peek(("packed", idx))
+        if cached is not None:
+            return cached
+        return self._decompress_payload(idx)
+
+    def _warm_dense(self, idx: int) -> np.ndarray:
+        """Readahead worker body, dense transport: decode unless
+        already resident (peek, for the same accounting reason)."""
+        cached = self.cache.peek(("dense", idx))
         if cached is not None:
             return cached
         return self._decode_chunk(idx)
 
     def _schedule_ahead(self, last_idx: int, packed: bool = False) -> None:
-        """Warm the ``depth`` chunks after ``last_idx`` in the background.
+        """Warm the chunks after ``last_idx`` in the background, to the
+        pool's (possibly cadence-adapted) current depth.
 
-        Dense transport warms full decodes into the cache; the packed
-        transport's cold cost is the first-touch digest verify, so it
-        warms ``_chunk_bytes`` (map + verify) instead. Errors raised by
-        a warm are delivered to the consumer when its cursor reaches the
-        failed chunk (ReadaheadPool.consume), in order."""
+        Called once per consumed block, which is also the pool's
+        consumer-cadence sample (``note_retire``). Dense transport
+        warms full decodes into the cache; the packed transport's cold
+        cost is the first-touch digest verify plus (for compressed
+        chunks) the inflate, so it warms the payload instead. Errors
+        raised by a warm are delivered to the consumer when its cursor
+        reaches the failed chunk (ReadaheadPool.consume), in order."""
         if self._ra is None:
             return
+        self._ra.note_retire(last_idx)
         n_chunks = len(self.manifest.chunks)
         for j in range(last_idx + 1,
                        min(last_idx + 1 + self._ra.depth, n_chunks)):
+            rec = self.manifest.chunks[j]
             if packed:
-                if j in self._verified:
+                if rec.codec == codecmod.RAW:
+                    if j in self._verified:
+                        continue
+                elif self.cache.peek(("packed", j)) is not None:
                     continue
-                self._ra.schedule(("bytes", j),
-                                  lambda j=j: self._chunk_bytes(j))
+                self._ra.schedule(("packed", j),
+                                  lambda j=j: self._warm_payload(j))
             else:
-                if self.cache.peek(j) is not None:
+                if self.cache.peek(("dense", j)) is not None:
                     continue
                 self._ra.schedule(("dense", j),
                                   lambda j=j: self._warm_dense(j))
@@ -298,7 +455,7 @@ class StoreSource:
     def _chunk_dense(self, idx: int) -> np.ndarray:
         """Dense int8 decode of one chunk, through the decode cache and
         (when armed) the readahead rendezvous."""
-        cached = self.cache.get(idx)
+        cached = self.cache.get(("dense", idx))
         if cached is not None:
             return cached
         if self._ra is not None:
@@ -324,6 +481,44 @@ class StoreSource:
         out = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
         return np.ascontiguousarray(out)
 
+    def decode_range_into(self, lo: int, hi: int, out: np.ndarray,
+                          col_off: int = 0) -> None:
+        """Decode global variants [lo, hi) into ``out[:, col_off:...]``
+        — the zero-copy drive the prefetch staging ring uses
+        (ingest/prefetch.py): cached/warmed chunks are block-copied,
+        everything else decodes STRAIGHT into the destination slab
+        through the native entry, with no intermediate dense buffer."""
+        if not 0 <= lo <= hi <= self.n_variants:
+            raise ValueError(
+                f"variant range [{lo}, {hi}) out of bounds for a "
+                f"{self.n_variants}-variant store"
+            )
+        for i, rec in self.manifest.chunks_for_range(lo, hi):
+            a, b = max(lo, rec.start), min(hi, rec.stop)
+            dst = col_off + (a - lo)
+            cached = self.cache.get(("dense", i))
+            if cached is None and self._ra is not None:
+                cached = self._ra.consume(("dense", i))  # re-raises
+            if cached is None and rec.codec != codecmod.RAW and (
+                    a > rec.start or b < rec.stop):
+                # A PARTIAL span of a cold compressed chunk: the native
+                # entry always inflates the whole payload, so decoding
+                # straight to the slab here would re-pay the full
+                # inflate for every covering block (2x+ whenever the
+                # block grid is finer than the chunk grid). Decode once
+                # into the cache and block-copy instead — the
+                # zero-intermediate path is reserved for spans that
+                # cover the chunk, and for raw chunks (whose partial
+                # unpack reads only the span's bytes off the mmap).
+                cached = self._decode_chunk(i)
+            if cached is not None:
+                np.copyto(out[:, dst:dst + (b - a)],
+                          cached[:, a - rec.start:b - rec.start])
+                continue
+            with telemetry.span("store.chunk_read", cat="store", chunk=i):
+                self._decode_span_into(i, a - rec.start, b - rec.start,
+                                       out, dst)
+
     # -- streaming transports ----------------------------------------------
 
     def _grid(self, block_variants: int):
@@ -345,24 +540,35 @@ class StoreSource:
         return BlockMeta(idx, lo, hi, contig,
                          pos[lo:hi] if pos is not None else None)
 
-    def blocks(self, block_variants: int, start_variant: int = 0):
-        """Dense blocks at any width; resume skips blocks starting
-        before the cursor (ceil-align for mid-block cursors, exact for
-        self-produced stops — the contract every geometry here keeps)."""
+    def block_spans(self, block_variants: int, start_variant: int = 0):
+        """(lo, hi, meta) for every dense-grid block — the decode-free
+        twin of :meth:`blocks` that lets a caller owning the
+        destination buffers (the prefetch staging ring) drive
+        :meth:`decode_range_into` itself. Same grid, same resume
+        semantics, same readahead scheduling."""
         for idx, lo, hi, contig in self._grid(block_variants):
             if lo < start_variant:
                 continue
             covering = self.manifest.chunks_for_range(lo, hi)
             if covering:
                 self._schedule_ahead(covering[-1][0])
-            yield self.read_range(lo, hi), self._meta(idx, lo, hi, contig)
+            yield lo, hi, self._meta(idx, lo, hi, contig)
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        """Dense blocks at any width; resume skips blocks starting
+        before the cursor (ceil-align for mid-block cursors, exact for
+        self-produced stops — the contract every geometry here keeps)."""
+        for lo, hi, meta in self.block_spans(block_variants, start_variant):
+            yield self.read_range(lo, hi), meta
 
     def packed_blocks(self, block_variants: int, start_variant: int = 0):
         """2-bit packed blocks for the packed transport. Zero-copy when
-        a block falls inside one chunk on the byte grid (the common
-        case: bv dividing chunk_variants); re-packed from the dense
-        decode otherwise — same bytes semantics either way (tail pad
-        codes are MISSING, free to every gram piece)."""
+        a block falls inside one raw-codec chunk on the byte grid (the
+        common case: bv dividing chunk_variants); compressed chunks
+        substitute their cached inflated payload for the mmap;
+        re-packed from the dense decode otherwise — same bytes
+        semantics every way (tail pad codes are MISSING, free to every
+        gram piece)."""
         if block_variants % bitpack.VARIANTS_PER_BYTE:
             raise ValueError(
                 f"packed_blocks needs block_variants divisible by "
@@ -378,11 +584,11 @@ class StoreSource:
             if len(covering) == 1 and (lo - covering[0][1].start) % vpb == 0:
                 i, rec = covering[0]
                 if self._ra is not None:
-                    warmed = self._ra.consume(("bytes", i))  # re-raises
+                    warmed = self._ra.consume(("packed", i))  # re-raises
                     raw = (warmed if warmed is not None
-                           else self._chunk_bytes(i))
+                           else self._payload(i))
                 else:
-                    raw = self._chunk_bytes(i)
+                    raw = self._payload(i)
                 b0 = (lo - rec.start) // vpb
                 b1 = bitpack.packed_width(hi - rec.start)
                 pblock = np.ascontiguousarray(raw[:, b0:b1])

@@ -287,8 +287,27 @@ def test_bitflip_fails_digest_verification(store_dir):
     open(victim, "wb").write(bytes(raw))
     with pytest.raises(StoreCorruptError, match="content address"):
         open_store(store_dir).read_range(0, 8)
-    # verify=False skips hashing (the documented fast-and-loose knob)
-    open_store(store_dir, verify=False).read_range(0, 8)
+    # verify=False skips hashing — but a compressed chunk whose stored
+    # bytes no longer inflate still fails LOUDLY through the decode
+    # path (garbage can't be silently decoded, unlike the raw codec).
+    with pytest.raises(StoreCorruptError):
+        open_store(store_dir, verify=False).read_range(0, 8)
+
+
+def test_bitflip_raw_codec_verify_off_is_fast_and_loose(tmp_path, genotypes):
+    """The documented fast-and-loose knob on a RAW-codec store: with
+    hashing skipped, a same-size bit flip reads back as (wrong) data —
+    the pre-compression behavior, preserved for raw chunks."""
+    src = ArraySource(genotypes)
+    d = str(tmp_path / "raw")
+    manifest = compact(d, src, chunk_variants=32, codec="raw")
+    victim = os.path.join(d, manifest.chunks[0].filename())
+    raw = bytearray(open(victim, "rb").read())
+    raw[7] ^= 0x40
+    open(victim, "wb").write(bytes(raw))
+    with pytest.raises(StoreCorruptError, match="content address"):
+        open_store(d).read_range(0, 8)
+    open_store(d, verify=False).read_range(0, 8)
 
 
 def test_missing_chunk_file_quarantined_not_retried(store_dir):

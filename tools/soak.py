@@ -86,6 +86,12 @@ SCENARIOS: tuple = (
                                             keep=8)),
     ("gram", "store.readahead.decode", "io_error",
      dict(after=(0, 2), max=(1, 1))),
+    # Same site, DENSE transport: the readahead warm runs the native
+    # decode-to-slab entry (inflate + unpack of the compressed chunk
+    # in one C call — store/codec.py), so the held-and-re-raised error
+    # contract is proven on the native path, not just the Python one.
+    ("gram-dense", "store.readahead.decode", "io_error",
+     dict(after=(0, 2), max=(1, 1))),
     ("gram", "device.put", "delay", dict(after=(0, 6), max=(1, 2),
                                          delay=0.01)),
     ("gram", "multihost.consensus", "delay",
@@ -208,13 +214,20 @@ class _Fixture:
             block_variants=cfg.block_variants,
         )
         src = runner.build_source(self.ingest_cfg)
+        # Default codec (zlib): every store round in the soak exercises
+        # compressed chunks — incl. truncate -> origin-heal, which must
+        # re-compress byte-identically to clear the ledger.
         compact(self.store_dir, src, chunk_variants=cfg.chunk_variants,
                 origin=origin_from_ingest(self.ingest_cfg,
                                           cfg.chunk_variants))
-        # Clean gram baseline over the store transport (the exact job
-        # the rounds run, no faults armed).
+        # Clean gram baselines over the store transport (the exact jobs
+        # the rounds run, no faults armed): packed (ibs) and dense
+        # (dot — the transport whose readahead warms run the native
+        # decode-to-slab entry).
         faults.disarm()
         self.baseline_sim = self._gram_job(None).similarity
+        self.baseline_sim_dense = self._gram_job(None,
+                                                 metric="dot").similarity
         # Serve fixture: model fit over the same panel + warmed engine.
         from spark_examples_tpu.pipelines.jobs import pcoa_job
         from spark_examples_tpu.serve import ProjectionEngine
@@ -256,7 +269,7 @@ class _Fixture:
             if close is not None:
                 close()
 
-    def _gram_job(self, ckpt_dir: str | None):
+    def _gram_job(self, ckpt_dir: str | None, metric: str = "ibs"):
         job = JobConfig(
             ingest=IngestConfig(
                 source="store", path=self.store_dir,
@@ -265,7 +278,7 @@ class _Fixture:
                 readahead_chunks=2, store_cache_mb=4,
             ),
             compute=ComputeConfig(
-                metric="ibs", checkpoint_dir=ckpt_dir,
+                metric=metric, checkpoint_dir=ckpt_dir,
                 checkpoint_every_blocks=2 if ckpt_dir else 0,
             ),
         )
@@ -322,7 +335,7 @@ def _snapshots_readable(tel_dir: str) -> str | None:
 
 
 def _run_gram_round(fx: _Fixture, i: int, spec: str,
-                    round_seed: int) -> list[str]:
+                    round_seed: int, metric: str = "ibs") -> list[str]:
     """One in-process gram round under `spec`, with the periodic
     live-telemetry flusher publishing snapshots throughout (the
     telemetry.flush site fires inside it); returns violations."""
@@ -335,10 +348,12 @@ def _run_gram_round(fx: _Fixture, i: int, spec: str,
             warnings.simplefilter("ignore", RuntimeWarning)
             flusher.start()
             try:
-                res = fx._gram_job(ckpt)
+                res = fx._gram_job(ckpt, metric=metric)
             finally:
                 flusher.stop()
-    if not np.array_equal(res.similarity, fx.baseline_sim):
+    baseline = (fx.baseline_sim_dense if metric == "dot"
+                else fx.baseline_sim)
+    if not np.array_equal(res.similarity, baseline):
         problems.append("gram result differs from clean baseline")
     reason = _snapshots_readable(tel)
     if reason:
@@ -485,6 +500,9 @@ def run_soak(cfg: SoakConfig) -> SoakReport:
         try:
             if jobkind == "gram":
                 problems = _run_gram_round(fx, i, spec, round_seed)
+            elif jobkind == "gram-dense":
+                problems = _run_gram_round(fx, i, spec, round_seed,
+                                           metric="dot")
             elif jobkind == "serve":
                 problems = _run_serve_round(fx, spec, round_seed)
             else:

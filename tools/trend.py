@@ -70,6 +70,10 @@ _EXPLICIT: dict[str, int | None] = {
     # from the store — a gain, despite the "_s" suffix.
     "store_serve_cold_start_delta_s": HIGHER_IS_BETTER,
     "tunnel_mb_s": None,  # session link rate: environment, not code
+    "store_link_mb_s": None,  # the SIMULATED link rate: a knob, not a result
+    # measured link-bound wall / ideal link wall: 1.0 = decode fully
+    # hidden behind the link, the feed-saturation contract.
+    "store_link_decode_overhead": LOWER_IS_BETTER,
     "cpu_baseline_s": None,  # the oracle's speed is not ours to gate
     "chaos_soak_iterations": None,
     "chaos_soak_healed": None,
@@ -79,9 +83,13 @@ _EXPLICIT: dict[str, int | None] = {
 # (match kind, token, direction) — first hit wins, checked in order:
 # throughput tokens before the bare "_s" time suffix ("_mb_s" ends
 # with "_s" too), relerr before "_vs_" ("relerr_vs_exact" is an error,
-# not a speedup ratio).
+# not a speedup ratio), stall/compression rules before the generic
+# suffixes (a feed-stall FRACTION must go down, a compression RATIO
+# up — store PR contract).
 _RULES: tuple[tuple[str, str, int], ...] = (
     ("contains", "relerr", LOWER_IS_BETTER),
+    ("contains", "stall_frac", LOWER_IS_BETTER),
+    ("contains", "compress_ratio", HIGHER_IS_BETTER),
     ("contains", "_mb_s", HIGHER_IS_BETTER),
     ("contains", "qps", HIGHER_IS_BETTER),
     ("contains", "flops", HIGHER_IS_BETTER),
