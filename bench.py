@@ -24,6 +24,13 @@ readahead pool) vs store-hit ingest throughput (headline
 delta, and a store-round-trip PCoA bit-identity check against the
 4-worker-compacted store (``configs.store``).
 
+``--kernels`` sweeps every registered gram-path kernel (the similarity-
+kernel registry: seven legacy metrics + jaccard) through the streamed
+registry route, reporting per-kernel ingest MB/s and GFLOP/s credited
+by each kernel's own registered FLOPs model (headline
+``kernel_jaccard_*`` / ``kernel_king_*`` / ``kernel_sweep_min_gflops``
+/ ``kernel_sweep_ok``).
+
 Every run APPENDS its headline (plus git sha / argv / platform
 provenance) to the append-only ``BENCH_HISTORY.jsonl``; ``--trend``
 additionally gates the run against the trailing history with the
@@ -528,6 +535,66 @@ def bench_braycurtis() -> dict:
     out["matmul_vs_exact_maxerr"] = float(
         jnp.abs(d_mm[:EXACT_N, :EXACT_N] - d_ex).max()
     )
+    return out
+
+
+def bench_kernels(store: str) -> dict:
+    """Kernel sweep (--kernels): every registered gram-path kernel —
+    the seven legacy metrics plus jaccard — streamed through the
+    registry route over the config-1 cohort, reporting per-kernel
+    packed/dense ingest MB/s and gram GFLOP/s. The FLOP credit comes
+    from each kernel's OWN registered FLOPs model, so a wrong model
+    shows up as an impossible rate, not a silent misreport.
+    ``braycurtis`` is a table-family kernel with its own dense-table
+    bench (config 3) and is deliberately absent here.
+
+    On an accelerator the sweep runs the full config-1 N; on the CPU
+    dev box it drops to N/4 samples x 4 blocks (logged — history rows
+    are backend-tagged, so CPU numbers only ever gate CPU numbers).
+    """
+    from spark_examples_tpu import kernels as kreg
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest.packed import load_packed
+    from spark_examples_tpu.pipelines.runner import run_similarity
+
+    cpu = jax.default_backend() == "cpu"
+    src_full = load_packed(store)
+    n = src_full.n_samples // 4 if cpu else src_full.n_samples
+    v = (4 if cpu else 16) * BLOCK
+    if cpu:
+        log(f"kernel sweep: CPU dev box — reduced slice N={n}, V={v} "
+            "(full config-1 N on an accelerator)")
+
+    def _slice(n_variants):
+        return type(src_full)(
+            packed=np.ascontiguousarray(
+                src_full.packed[:n, : n_variants // 4]),
+            v=n_variants, ids=src_full.ids[:n],
+        )
+
+    source, warm = _slice(v), _slice(BLOCK)
+    out: dict = {"n": n, "n_variants": v, "per_kernel": {}}
+    for name in kreg.gram_names():
+        job = JobConfig(
+            ingest=IngestConfig(source="packed", block_variants=BLOCK),
+            compute=ComputeConfig(metric=name),
+        )
+        run_similarity(job, source=warm)  # compile/warm at block shape
+        t0 = time.perf_counter()
+        res = run_similarity(job, source=source)
+        dt = time.perf_counter() - t0
+        rep = res.timer.report()
+        row = {
+            "total_s": round(dt, 3),
+            "gram_s": round(rep.get("gram", 0.0), 3),
+            "mb_s": round(rep.get("ingest_mb_per_s", 0.0), 1),
+            "gflops": round(rep.get("gram_gflops_per_s", 0.0), 1),
+        }
+        out["per_kernel"][name] = row
+        log(f"kernel sweep {name}: gram {row['gram_s']}s, "
+            f"{row['mb_s']} MB/s, {row['gflops']} GFLOP/s")
     return out
 
 
@@ -1486,6 +1553,13 @@ def main() -> None:
             log(f"store FAILED: {e!r}")
             configs["store"] = {"error": repr(e)}
 
+    if "--kernels" in sys.argv:
+        try:
+            configs["kernels"] = bench_kernels(store)
+        except Exception as e:
+            log(f"kernels FAILED: {e!r}")
+            configs["kernels"] = {"error": repr(e)}
+
     # Every TPU path whose time is reported must also recover the planted
     # structure — a fast wrong answer must not print a speedup.
     checks = [
@@ -1592,6 +1666,22 @@ def main() -> None:
             and configs["store"]["store_hit_vs_cold_parse"] >= 3.0
             and configs["store"]["compact_deterministic_w4_vs_w1"]
         )
+    if "kernels" in configs and "error" not in configs["kernels"]:
+        per = configs["kernels"]["per_kernel"]
+        # The two kernels the registry PR ships/highlights ride the
+        # headline by name; the rest gate through the sweep floor.
+        for kname in ("jaccard", "king"):
+            headline[f"kernel_{kname}_mb_s"] = per[kname]["mb_s"]
+            headline[f"kernel_{kname}_gflops"] = per[kname]["gflops"]
+        headline["kernel_sweep_min_gflops"] = min(
+            r["gflops"] for r in per.values())
+        from spark_examples_tpu import kernels as kreg
+        headline["kernel_sweep_ok"] = bool(
+            set(per) == set(kreg.gram_names())
+            and all(r["gflops"] > 0 and r["mb_s"] > 0
+                    for r in per.values())
+        )
+
     # Noise-aware trend gate (tools/trend.py): the candidate headline
     # vs the trailing BENCH_HISTORY.jsonl window. Checked BEFORE the
     # append so the run never gates against itself.

@@ -21,6 +21,7 @@ import sys
 import numpy as np
 
 from spark_examples_tpu.version import __version__  # noqa: F401 - CLI flag
+from spark_examples_tpu import kernels
 from spark_examples_tpu.core import config
 from spark_examples_tpu.core.config import (
     ComputeConfig,
@@ -177,10 +178,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     c = p.add_argument_group("compute")
     c.add_argument("--backend", default="jax-tpu",
                    choices=["jax-tpu", "cpu-reference"])
+    # Choices come from the kernel registry (jax-free import) — adding
+    # a kernel registration makes it CLI-reachable with no edit here.
     c.add_argument("--metric", default="ibs",
-                   choices=["ibs", "ibs2", "shared-alt", "grm", "king",
-                            "euclidean",
-                            "dot", "braycurtis"])
+                   choices=list(kernels.names()))
     c.add_argument("--num-pc", type=int, default=10)
     c.add_argument("--mesh-shape", default=None,
                    help="IxJ, e.g. 2x4 (default: auto-factor devices)")

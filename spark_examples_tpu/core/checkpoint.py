@@ -621,10 +621,7 @@ def load(path: str, metric: str, sample_ids: list[str],
         else:
             from spark_examples_tpu.ops import gram
 
-            expected = sorted(
-                ("zz", "nvar") if metric == "grm"
-                else gram.PIECES_FOR_METRIC[metric]
-            )
+            expected = sorted(gram.acc_leaves(metric))
         if manifest["leaves"] != expected:
             raise ValueError(
                 f"checkpoint at {path} holds accumulator leaves "

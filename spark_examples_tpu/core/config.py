@@ -16,6 +16,8 @@ from __future__ import annotations
 import dataclasses
 from dataclasses import dataclass, field
 
+from spark_examples_tpu import kernels
+
 # Chunk-payload codec spellings of the dataset store's --store-codec
 # flag (store/codec.py consumes this tuple — config cannot import the
 # store package without a cycle): "raw" = no compression (the v1/v2
@@ -49,30 +51,21 @@ SKETCH_ITERS_DEFAULT = 2
 
 # Metrics whose centered PCoA/PCA operator is an exact Gram of per-block
 # streamable features A_b — B = (J A)(J A)^T — which is what makes the
-# one-pass range sketch exact up to solver error: shared-alt (A = alt-
-# carrier indicators), grm (A = VanRaden-standardized Z, /nvar), dot
-# (A = raw masked values) and euclidean (ditto; exact when no calls are
-# missing — with missingness the sketch models zero-imputed dosages,
-# while the exact route's qc term keeps per-pair denominators). The
-# ratio metrics (ibs / ibs2 / king) finalize with ELEMENTWISE pair-count
-# divisions (d1/2m, phi = num/den) that are not bilinear in any streamed
-# feature — they mathematically require the materialized N x N and stay
-# on the exact rung.
-SKETCH_METRICS = ("shared-alt", "grm", "dot", "euclidean")
+# one-pass range sketch exact up to solver error. COMPUTED from the
+# kernel registry (spark_examples_tpu/kernels — jax-free at import, so
+# config can consume it), never hand-listed: a kernel declaring a
+# FactorSketch lands here automatically. Ratio metrics declaring a
+# DualSketch (DUAL_SKETCH_METRICS: numerator + pair-count denominator
+# streamed as two sketches in the same pass) are sketchable too;
+# kernels declaring neither (ibs2/king) stay on the exact rung, and
+# the rejection text names all three groups from the registry.
+SKETCH_METRICS = kernels.factor_sketch_names()
+DUAL_SKETCH_METRICS = kernels.dual_sketch_names()
 
-
-def unsketchable_metric_error(metric: str, solver: str) -> str:
-    """THE rejection text for a non-sketchable metric — shared by the
-    config-time validation below and the runtime gate in
-    solvers/sketch.py (which also catches a ``metric=None`` driver
-    default resolving to ibs), so the two can never drift apart."""
-    return (
-        f"--solver {solver} does not support --metric {metric}: the "
-        "sketch streams an exact Gram factor per block, which exists "
-        f"for {' | '.join(SKETCH_METRICS)}; ratio metrics (ibs/ibs2/"
-        "king) finalize with elementwise pair-count divisions that "
-        "require the materialized N x N — use --solver exact for them"
-    )
+# Back-compat alias: the one rejection-text builder now lives with the
+# registry (kernels.unsketchable_metric_error) so config-time
+# validation, the solvers' runtime gate, and the docs can never drift.
+unsketchable_metric_error = kernels.unsketchable_metric_error
 
 
 @dataclass(frozen=True)
@@ -258,13 +251,13 @@ class ComputeConfig:
     """Compute-path knobs."""
 
     backend: str = "jax-tpu"  # jax-tpu | cpu-reference
-    # Gram-path metrics: ibs | ibs2 | shared-alt | grm | king |
-    # euclidean | dot (streamed genotype blocks). "braycurtis" is valid at the pipeline
-    # level only — it dispatches to the dense-table distances.braycurtis
-    # path, not the gram accumulator. None means "the driver's default"
-    # (ibs for similarity/pcoa; the PCA driver always uses shared-alt) —
-    # a real sentinel, so drivers can tell an explicit choice from an
-    # unset field.
+    # Any kernel registered in spark_examples_tpu/kernels (gram-path
+    # streamed metrics plus table-family pipelines like braycurtis,
+    # which dispatches to its own dense-table runner, not the gram
+    # accumulator). None means "the driver's default" (ibs for
+    # similarity/pcoa; the PCA driver always uses shared-alt) — a real
+    # sentinel, so drivers can tell an explicit choice from an unset
+    # field. Unknown names are rejected below with the registry listed.
     metric: str | None = None
     # braycurtis lowering: "auto" picks "pallas" on an accelerator
     # (measured fastest AND exact — BASELINE.md config 3) and "exact"
@@ -343,6 +336,15 @@ class ComputeConfig:
                "rung; each is one full pass over the cohort")
         _check("--sketch-seed", self.sketch_seed, -(2 ** 63), 2 ** 63 - 1,
                "probe RNG seed; a resumed job must keep it")
+        # Unknown metrics die HERE with the registered kernels named —
+        # the kernel registry is the single source of truth, so this
+        # message can never go stale against the actual metric set.
+        if self.metric is not None and kernels.maybe_get(self.metric) is None:
+            raise ValueError(
+                f"bad compute config: --metric={self.metric!r} — "
+                f"registered kernels: {' | '.join(sorted(kernels.names()))} "
+                "(see README 'Similarity kernels' for how to add one)"
+            )
         if self.solver != "exact":
             if self.sketch_rank < self.num_pc:
                 raise ValueError(
@@ -358,11 +360,11 @@ class ComputeConfig:
                     "--sketch-iters=0 is the plain sketch rung — ask for "
                     "--solver=sketch, or give corrected >= 1 extra pass"
                 )
-            if self.metric is not None and self.metric not in SKETCH_METRICS:
-                raise ValueError(
-                    "bad compute config: "
-                    + unsketchable_metric_error(self.metric, self.solver)
-                )
+            if self.metric is not None:
+                try:
+                    kernels.check_sketchable(self.metric, self.solver)
+                except ValueError as e:
+                    raise ValueError(f"bad compute config: {e}") from None
 
 
 @dataclass

@@ -464,6 +464,21 @@ NAMES: dict[str, tuple[str, str]] = {
         "allocated for this cohort/metric — the allocation the sketch "
         "path exists to never make",
     ),
+    "solver.dual": (
+        "gauge",
+        "1 when this sketch job streamed a ratio metric's dual "
+        "(numerator + pair-count denominator) sketches, 0 for the "
+        "single-factor construction — which operator family the "
+        "ladder's relerr claims apply to",
+    ),
+    "solver.dual_den_defect": (
+        "gauge",
+        "measured rank-1 residual of the ratio denominator "
+        "(||DEN Q - a(a^T Q)||_F / ||DEN Q||_F from the pass-0 dual "
+        "sketches) — 0 means the scaled operator is exact; larger "
+        "means the dual rungs embed a denominator approximation the "
+        "exact rung does not",
+    ),
     # -- histograms -------------------------------------------------------
     "prefetch.put_wait_s": (
         "histogram",

@@ -1,0 +1,26 @@
+"""Similarity-kernel registry (see kernels/base.py for the contract).
+
+Importing the package registers the built-in kernels; the public
+surface is the registry accessors. jax-free at import time — safe for
+``core/config.py`` and the supervised CLI parent.
+"""
+
+from spark_examples_tpu.kernels.base import (  # noqa: F401
+    CrossSpec,
+    DualSketch,
+    FactorSketch,
+    Kernel,
+    all_kernels,
+    check_sketchable,
+    dual_sketch_names,
+    factor_sketch_names,
+    get,
+    gram_names,
+    maybe_get,
+    names,
+    register,
+    unregister,
+    unsketchable_metric_error,
+    unsketchable_names,
+)
+from spark_examples_tpu.kernels import builtin  # noqa: F401  (registers)

@@ -1,0 +1,318 @@
+"""The similarity-kernel registry: one declarative object per metric.
+
+The reference repo's whole point is a *family* of similarity matrices
+computed in one pass over variants — but until this module the family
+was frozen into ``if metric ==`` chains spread across ``ops/gram.py``,
+``ops/distances.py``, ``parallel/gram_sharded.py`` and
+``pipelines/runner.py`` (ROADMAP item 1). A :class:`Kernel` gathers
+everything a metric is into one object:
+
+- **accumulator schema** — which leaves the streaming pass accumulates
+  (raw int32 matmul products for the counting family, custom f32 leaves
+  for the float family), and which of them are scalars (replicated, not
+  tiled, under a tile2d plan);
+- **per-tile update** — counting kernels ride the shared int8-operand
+  matmul machinery (``ops/genotype.py``) on both the dense and the
+  2-bit-packed transport; float kernels (GRM) supply their own update
+  and tile2d body;
+- **finalize** — accumulated statistics -> ``{"similarity",
+  "distance"}``, in BOTH the jax form (``finalize``) and the NumPy
+  oracle mirror (``np_finalize``) so the two can never drift apart
+  silently (the kernel lint asserts both exist);
+- **int32 overflow budget** — the worst per-variant increment feeding
+  the runner's exactness guard, with ``value_scaled_budget`` for
+  kernels whose increment scales with the table's max value;
+- **FLOPs model** — ``flops(n, v)`` matmul work per block, for GFLOPS
+  reporting and the bench kernel sweep;
+- **sketch streamability** — a :class:`FactorSketch` when the centered
+  solve operator is an exact Gram of per-block streamable features
+  (the PR-7 construction), or a :class:`DualSketch` when the metric is
+  a *ratio*: numerator and pair-count denominator streamed as TWO
+  low-rank sketches in the same variant pass (arXiv:1911.04200's
+  communication-efficient sketching direction), lifting ratio metrics
+  out of the old hard-coded rejection;
+- **cross-cohort projectability** — a :class:`CrossSpec` makes a
+  fitted PCoA model of this kernel servable: the cross statistics to
+  stream and the squared-distance finalize the projection applies.
+
+The registry is the single source of truth consumed by ``ops/gram.py``
+(init/update/combine/flops), ``ops/distances.py`` (finalize),
+``parallel/gram_sharded.py`` (accumulator shardings, tile2d body),
+``pipelines/runner.py`` (pack-stream auto selection, int32 budget,
+table-path dispatch), ``core/config.py`` (validation messages,
+computed ``SKETCH_METRICS``), ``solvers/`` (streamability gates) and
+``pipelines/project.py`` / ``serve/`` (projectability). Adding a
+kernel is ONE registration in ``kernels/builtin.py`` — no consumer
+changes.
+
+This module (and the registrations) import NO jax at module scope:
+``core/config.py`` pulls the registry in for validation, and the
+supervised CLI parent must parse configs without ever initializing a
+device (core/supervisor.py). Every jax-touching callable on a kernel
+imports lazily at call time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class FactorSketch:
+    """Single-factor streamability: the metric's centered solve operator
+    is ``B = (J A)(J A)^T / denom`` for per-block streamable features
+    ``A_b = features(block)`` — the PR-7 sketch construction.
+
+    ``features(block, precise) -> (a, kept)``: the (N, v) f32 feature
+    columns for one dosage block plus the kept-variant count feeding
+    the denominator (0 when unused). ``uses_nvar``: divide the
+    finalized operator by the accumulated kept count (GRM).
+    """
+
+    features: Callable
+    uses_nvar: bool = False
+
+
+@dataclass(frozen=True)
+class DualSketch:
+    """Ratio-metric streamability: similarity ``S = NUM ⊘ DEN`` with
+    both NUM and the pair-count denominator DEN sums of cross-products
+    of per-block streamable feature columns. The solver streams
+    ``NUM @ Q`` and ``DEN @ Q`` as two sketches in the SAME variant
+    pass, extracts the dominant (Perron) rank-1 factor ``a a^T`` of DEN
+    from its sketch, and solves the eigenproblem of the *scaled*
+    operator ``B = J diag(1/a) NUM diag(1/a) J`` — exact whenever DEN
+    is rank-1 (e.g. IBS pair counts with no missing calls), and a
+    controlled approximation otherwise (solvers/driver.py documents
+    the geometry).
+
+    ``operands(block) -> {name: (N, v) f32}``; ``num_terms`` /
+    ``den_terms`` are ``(left, right, weight)`` triples meaning
+    ``sum_b w * L_b R_b^T``. ``num_psd``: NUM is positive
+    semi-definite, enabling the single-pass Nystrom rung; kernels with
+    an indefinite numerator are corrected-rung-only.
+    """
+
+    operands: Callable
+    num_terms: tuple[tuple[str, str, float], ...]
+    den_terms: tuple[tuple[str, str, float], ...]
+    num_psd: bool = True
+
+
+@dataclass(frozen=True)
+class CrossSpec:
+    """Out-of-sample projectability of a fitted PCoA model: ``stats``
+    are the :data:`ops.genotype.CROSS_STATS` names to stream between
+    the query cohort and the reference panel; ``d2(acc)`` finalizes the
+    accumulated (A, N_ref) statistics into SQUARED cross distances in
+    the kernel's own distance convention (jax, called under jit)."""
+
+    stats: tuple[str, ...]
+    d2: Callable
+
+
+@dataclass(frozen=True)
+class Kernel:
+    """One similarity kernel, declaratively. See the module docstring
+    for the field-by-field contract; ``family`` is:
+
+    - ``"count"`` — int32 raw-product accumulation over the shared
+      int8 matmul operands (the IBS family, jaccard, king, dot, ...);
+    - ``"float"`` — custom f32 accumulators and update (GRM);
+    - ``"table"`` — not a gram-path kernel at all: a dense-table
+      pipeline with its own runner (braycurtis).
+    """
+
+    name: str
+    summary: str
+    family: str = "count"
+    # count family: raw products accumulated / stats finalize consumes.
+    pieces: tuple[str, ...] = ()
+    stats: tuple[str, ...] = ()
+    finalize: Callable | None = None      # stats -> {"similarity","distance"} (jax)
+    np_finalize: Callable | None = None   # NumPy oracle mirror
+    # 2-bit packable under --pack-stream auto (inputs are dosages by
+    # definition); False keeps arbitrary-int8-table kernels dense.
+    pack_auto: bool = True
+    # int32 exactness guard: worst per-variant accumulator increment
+    # (None = exempt, e.g. f32 accumulation); value_scaled_budget
+    # scales it by the observed max table value squared (dot/euclidean).
+    max_increment: int | None = None
+    value_scaled_budget: bool = False
+    flops: Callable | None = None         # (n, v) -> matmul FLOPs per block
+    sketch: FactorSketch | DualSketch | None = None
+    cross: CrossSpec | None = None
+    # float family hooks (all lazy-importing; None for count/table).
+    acc_leaves_: tuple[str, ...] | None = None
+    scalar_leaves: tuple[str, ...] = ()   # replicated (not tiled) leaves
+    init: Callable | None = None          # n -> fresh accumulator dict
+    update_impl: Callable | None = None   # (packed) -> (acc, block, precise) -> acc
+    tile_body: Callable | None = None     # tile2d shard_map body hook
+    oracle_similarity: Callable | None = None  # cpu-reference route
+    # table family hook: (job, source, timer) -> SimilarityResult.
+    table_runner: Callable | None = None
+
+    @property
+    def is_gram(self) -> bool:
+        """Rides the streaming gram accumulator (count or float)."""
+        return self.family in ("count", "float")
+
+    @property
+    def acc_leaves(self) -> tuple[str, ...]:
+        """Accumulator leaf names (checkpoint schema, shardings)."""
+        return self.acc_leaves_ if self.acc_leaves_ is not None else self.pieces
+
+
+_REGISTRY: dict[str, Kernel] = {}
+
+
+def register(kernel: Kernel) -> Kernel:
+    """Add a kernel to the registry, validating the family contract up
+    front — a half-declared kernel must die at import, not as a
+    KeyError deep inside a streaming job."""
+    if kernel.name in _REGISTRY:
+        raise ValueError(f"kernel {kernel.name!r} is already registered")
+    if kernel.family not in ("count", "float", "table"):
+        raise ValueError(
+            f"kernel {kernel.name!r}: unknown family {kernel.family!r} "
+            "(count | float | table)"
+        )
+    if kernel.flops is None:
+        raise ValueError(
+            f"kernel {kernel.name!r} declares no FLOPs model — every "
+            "kernel must be benchmarkable (flops=(n, v) -> float)"
+        )
+    if kernel.family == "count":
+        missing = [f for f in ("pieces", "stats", "finalize", "np_finalize")
+                   if not getattr(kernel, f)]
+        if missing or kernel.max_increment is None:
+            raise ValueError(
+                f"count kernel {kernel.name!r} is missing "
+                f"{missing + (['max_increment'] if kernel.max_increment is None else [])}"
+            )
+    if kernel.family == "float":
+        missing = [f for f in ("init", "update_impl", "tile_body",
+                               "finalize", "np_finalize", "acc_leaves_")
+                   if getattr(kernel, f) is None]
+        if missing:
+            raise ValueError(
+                f"float kernel {kernel.name!r} is missing {missing}")
+    if kernel.family == "table" and kernel.table_runner is None:
+        raise ValueError(
+            f"table kernel {kernel.name!r} declares no table_runner")
+    if isinstance(kernel.sketch, DualSketch):
+        declared = _dual_operand_names(kernel.sketch)
+        for side in (kernel.sketch.num_terms, kernel.sketch.den_terms):
+            for left, right, _w in side:
+                if left not in declared or right not in declared:
+                    raise ValueError(
+                        f"kernel {kernel.name!r}: dual-sketch term "
+                        f"({left!r}, {right!r}) names an operand the "
+                        f"spec never declares ({sorted(declared)})"
+                    )
+    _REGISTRY[kernel.name] = kernel
+    return kernel
+
+
+def _dual_operand_names(spec: DualSketch) -> set[str]:
+    """Operand names a dual spec's terms may reference — declared as
+    ``spec.operand_names`` metadata on the operands callable (set by
+    the registration helper) so validation never has to call the
+    jax-touching builder at import time."""
+    return set(getattr(spec.operands, "operand_names", ())) or {
+        l for terms in (spec.num_terms, spec.den_terms)
+        for (l, r, _w) in terms for l in (l, r)
+    }
+
+
+def unregister(name: str) -> None:
+    """Remove a kernel (test scaffolding for registration machinery)."""
+    _REGISTRY.pop(name, None)
+
+
+def maybe_get(name: str) -> Kernel | None:
+    """The non-raising lookup (dispatch sites that build their own
+    error message)."""
+    return _REGISTRY.get(name)
+
+
+def get(name: str) -> Kernel:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown metric {name!r}; registered kernels: "
+            f"{' | '.join(sorted(_REGISTRY))}"
+        ) from None
+
+
+def all_kernels() -> tuple[Kernel, ...]:
+    return tuple(_REGISTRY.values())
+
+
+def names() -> tuple[str, ...]:
+    """Every registered kernel name, in registration order."""
+    return tuple(_REGISTRY)
+
+
+def gram_names() -> tuple[str, ...]:
+    """Kernels riding the streaming gram accumulator."""
+    return tuple(k.name for k in _REGISTRY.values() if k.is_gram)
+
+
+def factor_sketch_names() -> tuple[str, ...]:
+    """Kernels streamable as a single-factor sketch (PR-7 form)."""
+    return tuple(k.name for k in _REGISTRY.values()
+                 if isinstance(k.sketch, FactorSketch))
+
+
+def dual_sketch_names() -> tuple[str, ...]:
+    """Ratio kernels streamable as a num/den dual sketch."""
+    return tuple(k.name for k in _REGISTRY.values()
+                 if isinstance(k.sketch, DualSketch))
+
+
+def unsketchable_names() -> tuple[str, ...]:
+    """Gram kernels with no declared streamability (exact rung only)."""
+    return tuple(k.name for k in _REGISTRY.values()
+                 if k.is_gram and k.sketch is None)
+
+
+def unsketchable_metric_error(metric: str, solver: str) -> str:
+    """THE rejection text for a metric the sketch ladder cannot run —
+    derived from the registry (never a stale hand-listed string),
+    shared by config-time validation and the solvers' runtime gate."""
+    kern = _REGISTRY.get(metric)
+    if kern is not None and isinstance(kern.sketch, DualSketch):
+        # Reachable only for a dual kernel whose numerator is not PSD:
+        # the single-pass Nystrom rung needs a PSD core.
+        return (
+            f"--solver {solver} does not support --metric {metric}: its "
+            "dual-sketch numerator is not PSD, so the single-pass "
+            "Nystrom rung is unavailable — use --solver corrected "
+            "(streamed subspace iteration handles indefinite operators)"
+        )
+    return (
+        f"--solver {solver} does not support --metric {metric}: the "
+        "sketch streams an exact Gram factor per block, which exists "
+        f"for {' | '.join(factor_sketch_names())}; ratio metrics "
+        f"({' | '.join(dual_sketch_names())}) stream numerator + "
+        "pair-count denominator as a dual sketch; metrics declaring "
+        f"neither ({' | '.join(unsketchable_names())}) require the "
+        "materialized N x N — use --solver exact for them"
+    )
+
+
+def check_sketchable(metric: str, solver: str) -> None:
+    """Raise (with the registry-derived fix named) unless ``metric``
+    can run the ``solver`` rung. The one gate shared by config-time
+    validation (core/config.py) and the runtime driver
+    (solvers/sketch.py) — one text builder, no drift."""
+    kern = _REGISTRY.get(metric)
+    spec = kern.sketch if kern is not None else None
+    if spec is None:
+        raise ValueError(unsketchable_metric_error(metric, solver))
+    if (isinstance(spec, DualSketch) and solver == "sketch"
+            and not spec.num_psd):
+        raise ValueError(unsketchable_metric_error(metric, solver))

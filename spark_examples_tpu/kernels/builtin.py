@@ -1,0 +1,508 @@
+"""Built-in kernel registrations: the seven pre-existing metrics
+re-registered through the registry with pinned bit-identity (their
+finalize bodies are the exact code that used to live in
+``ops/distances.py`` / ``utils/oracle.py`` — tests pin the outputs
+byte-identical), plus **jaccard**, the first genuinely new workload the
+registry ships (carrier-set similarity for duplicate detection and
+cohort dedup), and **braycurtis** as the table-family registration of
+the existing dense-table pipeline.
+
+No jax at module scope (see kernels/base.py) — every jax-touching
+callable imports lazily at call time.
+"""
+
+from __future__ import annotations
+
+from spark_examples_tpu.kernels.base import (
+    CrossSpec,
+    DualSketch,
+    FactorSketch,
+    Kernel,
+    register,
+)
+
+
+def _np_gower(sim):
+    """NumPy twin of ``ops.distances.similarity_to_distance`` — the
+    Gower transform ``d = sqrt(s_ii + s_jj - 2 s_ij)`` clamped at 0.
+    ONE definition for every np_finalize below, so a clamp/dtype fix
+    can never drift between kernels (the jax side has the same single
+    definition)."""
+    import numpy as np
+
+    diag = np.diagonal(sim)
+    return np.sqrt(np.maximum(diag[:, None] + diag[None, :] - 2 * sim, 0.0))
+
+
+def _count_flops(pieces: tuple[str, ...]):
+    """Matmul FLOPs per block for a counting kernel: one matmul per
+    int8-split term of each accumulated product (the radix-128 ``qc``
+    lowering makes euclidean 3, not 2)."""
+
+    def flops(n: int, v: int) -> float:
+        from spark_examples_tpu.ops import genotype
+
+        n_matmuls = sum(
+            len(genotype._INT8_SPLIT.get(p, (None,))) for p in pieces
+        )
+        return 2.0 * n * n * v * n_matmuls
+
+    return flops
+
+
+# --------------------------------------------------------------- ibs
+
+def _ibs_finalize(stats):
+    import jax.numpy as jnp
+
+    m = stats["m"]
+    dist = jnp.where(m > 0, stats["d1"] / (2.0 * m), 0.0)
+    return {"similarity": 1.0 - dist, "distance": dist}
+
+
+def _ibs_np_finalize(acc):
+    import numpy as np
+
+    with np.errstate(invalid="ignore", divide="ignore"):
+        dist = np.where(acc["m"] > 0, acc["d1"] / (2.0 * acc["m"]), 0.0)
+    return {"similarity": 1.0 - dist, "distance": dist}
+
+
+def _ibs_dual_operands(block):
+    import jax.numpy as jnp
+
+    valid = block >= 0
+    c = valid.astype(jnp.float32)
+    t1 = (block >= 1).astype(jnp.float32)
+    t2 = (block >= 2).astype(jnp.float32)
+    return {"c": c, "t1": t1, "t2": t2, "y": t1 + t2}
+
+
+_ibs_dual_operands.operand_names = ("c", "t1", "t2", "y")
+
+
+def _ibs_cross_d2(acc):
+    import jax.numpy as jnp
+
+    m = acc["m"]
+    dist = jnp.where(m > 0, acc["d1"].astype(jnp.float32) / (2.0 * m), 0.0)
+    return dist * dist
+
+
+register(Kernel(
+    name="ibs",
+    summary="PLINK-convention identity-by-state over pairwise-complete "
+            "variants: dist = sum|a-b| / (2m)",
+    family="count",
+    pieces=("cc", "yc", "t1t1", "t2t2"),
+    stats=("m", "d1"),
+    finalize=_ibs_finalize,
+    np_finalize=_ibs_np_finalize,
+    pack_auto=True,
+    max_increment=2,  # yc with y <= 2
+    flops=_count_flops(("cc", "yc", "t1t1", "t2t2")),
+    # Dual sketch: similarity numerator NUM = 2m - d1 =
+    # sum_v c_i c_j (2 - |a-b|) — a PSD kernel matrix per variant
+    # ([[2,1,0],[1,2,1],[0,1,2]] is PSD and masking is a congruence) —
+    # over the pair-count denominator DEN = 2m (exactly rank-1 when no
+    # calls are missing, so the scaled operator is then exact).
+    sketch=DualSketch(
+        operands=_ibs_dual_operands,
+        num_terms=(("c", "c", 2.0), ("y", "c", -1.0), ("c", "y", -1.0),
+                   ("t1", "t1", 2.0), ("t2", "t2", 2.0)),
+        den_terms=(("c", "c", 2.0),),
+        num_psd=True,
+    ),
+    cross=CrossSpec(stats=("m", "d1"), d2=_ibs_cross_d2),
+))
+
+
+# -------------------------------------------------------------- ibs2
+
+def _ibs2_finalize(stats):
+    import jax.numpy as jnp
+
+    m = stats["m"]
+    sim = jnp.where(m > 0, stats["ibs2"] / (1.0 * m), 1.0)
+    return {"similarity": sim, "distance": 1.0 - sim}
+
+
+def _ibs2_np_finalize(acc):
+    import numpy as np
+
+    with np.errstate(invalid="ignore", divide="ignore"):
+        sim = np.where(acc["m"] > 0, acc["ibs2"] / acc["m"], 1.0)
+    return {"similarity": sim, "distance": 1.0 - sim}
+
+
+register(Kernel(
+    name="ibs2",
+    summary="fraction of pairwise-complete variants with identical "
+            "genotype",
+    family="count",
+    pieces=("cc", "t1c", "t1t1", "t1t2", "t2t2"),
+    stats=("m", "ibs2"),
+    finalize=_ibs2_finalize,
+    np_finalize=_ibs2_np_finalize,
+    pack_auto=True,
+    max_increment=2,  # t1c-family indicator sums
+    flops=_count_flops(("cc", "t1c", "t1t1", "t1t2", "t2t2")),
+))
+
+
+# --------------------------------------------------------- shared-alt
+
+def _shared_alt_finalize(stats):
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops.distances import similarity_to_distance
+
+    s = stats["s"].astype(jnp.float32)
+    return {"similarity": s, "distance": similarity_to_distance(s)}
+
+
+def _shared_alt_np_finalize(acc):
+    return {"similarity": acc["s"], "distance": _np_gower(acc["s"])}
+
+
+def _shared_alt_features(block, precise):
+    import jax.numpy as jnp
+
+    a = (block >= 1).astype(jnp.float32)
+    return a, jnp.float32(0.0)  # denominator unused
+
+
+register(Kernel(
+    name="shared-alt",
+    summary="raw shared-alt-carrier counts (the PCA driver's "
+            "similarity)",
+    family="count",
+    pieces=("t1t1",),
+    stats=("s",),
+    finalize=_shared_alt_finalize,
+    np_finalize=_shared_alt_np_finalize,
+    pack_auto=True,
+    max_increment=1,
+    flops=_count_flops(("t1t1",)),
+    sketch=FactorSketch(features=_shared_alt_features),
+))
+
+
+# ---------------------------------------------------------- euclidean
+
+def _euclidean_finalize(stats):
+    import jax.numpy as jnp
+
+    d = jnp.sqrt(jnp.maximum(stats["e2"].astype(jnp.float32), 0.0))
+    return {"similarity": -d, "distance": d}
+
+
+def _euclidean_np_finalize(acc):
+    import numpy as np
+
+    d = np.sqrt(np.maximum(acc["e2"], 0.0))
+    return {"similarity": -d, "distance": d}
+
+
+def _raw_value_features(block, precise):
+    import jax.numpy as jnp
+
+    a = jnp.where(block >= 0, block, 0).astype(jnp.float32)
+    return a, jnp.float32(0.0)
+
+
+register(Kernel(
+    name="euclidean",
+    summary="exact raw-value euclidean distance for arbitrary int8 "
+            "tables",
+    family="count",
+    pieces=("qc", "yy"),
+    stats=("e2",),
+    finalize=_euclidean_finalize,
+    np_finalize=_euclidean_np_finalize,
+    pack_auto=False,  # arbitrary int8 values, not 2-bit representable
+    max_increment=4,  # qc/yy at dosage values; m^2 in general
+    value_scaled_budget=True,
+    flops=_count_flops(("qc", "yy")),
+    sketch=FactorSketch(features=_raw_value_features),
+))
+
+
+# ---------------------------------------------------------------- dot
+
+def _dot_finalize(stats):
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops.distances import similarity_to_distance
+
+    dot = stats["dot"].astype(jnp.float32)
+    return {"similarity": dot, "distance": similarity_to_distance(dot)}
+
+
+def _dot_np_finalize(acc):
+    return {"similarity": acc["dot"], "distance": _np_gower(acc["dot"])}
+
+
+register(Kernel(
+    name="dot",
+    summary="raw-value inner products for arbitrary int8 tables",
+    family="count",
+    pieces=("yy",),
+    stats=("dot",),
+    finalize=_dot_finalize,
+    np_finalize=_dot_np_finalize,
+    pack_auto=False,
+    max_increment=4,
+    value_scaled_budget=True,
+    flops=_count_flops(("yy",)),
+    sketch=FactorSketch(features=_raw_value_features),
+))
+
+
+# --------------------------------------------------------------- king
+
+def _king_finalize(stats):
+    import jax.numpy as jnp
+
+    # KING-robust kinship (Manichaikul 2010, between-family form):
+    # phi = (N_AaAa - 2 * N_AA,aa) / (N_Aa(i) + N_Aa(j)), hets counted
+    # over pairwise-complete variants. Pairs sharing no het variants
+    # are uninformative -> phi 0 (unrelated); the diagonal is pinned to
+    # self-kinship 0.5 even for samples with zero het calls (inbred
+    # lines, haploid 0/2 coding) — a nonzero self-distance would poison
+    # the Gower centering every downstream PCoA applies.
+    den = (stats["hc"] + stats["hc"].T).astype(jnp.float32)
+    num = (stats["hh"] - 2 * stats["opp"]).astype(jnp.float32)
+    phi = jnp.where(den > 0, num / den, 0.0)
+    n = phi.shape[0]
+    phi = jnp.where(jnp.eye(n, dtype=bool), 0.5, phi)
+    return {"similarity": phi,
+            "distance": jnp.maximum(0.5 - phi, 0.0)}
+
+
+def _king_np_finalize(acc):
+    import numpy as np
+
+    den = acc["hc"] + acc["hc"].T
+    with np.errstate(invalid="ignore", divide="ignore"):
+        phi = np.where(den > 0, (acc["hh"] - 2 * acc["opp"]) / den, 0.0)
+    np.fill_diagonal(phi, 0.5)  # self-kinship even with zero hets
+    return {"similarity": phi,
+            "distance": np.maximum(0.5 - phi, 0.0)}
+
+
+register(Kernel(
+    name="king",
+    summary="KING-robust kinship (relatedness QC: dup ~0.5, "
+            "parent-child ~0.25)",
+    family="count",
+    pieces=("t1c", "t2c", "t1t1", "t1t2", "t2t2"),
+    stats=("hh", "opp", "hc"),
+    finalize=_king_finalize,
+    np_finalize=_king_np_finalize,
+    pack_auto=True,
+    max_increment=2,  # finalize sums hc + hc^T / hh - 2*opp in int32
+    flops=_count_flops(("t1c", "t2c", "t1t1", "t1t2", "t2t2")),
+    # No sketch spec: phi's numerator (hh - 2*opp) is indefinite AND
+    # its het-count denominator is far from rank-1 (zero-het samples),
+    # so neither sketch form applies — exact rung only, and the
+    # registry-derived rejection says so.
+))
+
+
+# ------------------------------------------------------------ jaccard
+
+def _jaccard_finalize(stats):
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops.distances import similarity_to_distance
+
+    # Carrier-set Jaccard over pairwise-complete variants: intersection
+    # = shared-alt count, union = sc + sc^T - s with sc[i, j] = #(i
+    # carries alt AND j's call is valid). Pairs with an empty union
+    # (neither carries anything) cannot be distinguished from identical
+    # -> similarity 1, the same spirit as ibs's zero-overlap convention.
+    # The diagonal is exactly 1 (union_ii == inter_ii == carrier
+    # count), so the Gower distance is sqrt(2(1-J)) — itself a metric.
+    s = stats["s"]
+    union = stats["sc"] + stats["sc"].T - s
+    sim = jnp.where(union > 0, s / union, 1.0)
+    return {"similarity": sim, "distance": similarity_to_distance(sim)}
+
+
+def _jaccard_np_finalize(acc):
+    import numpy as np
+
+    s = acc["s"]
+    union = acc["sc"] + acc["sc"].T - s
+    with np.errstate(invalid="ignore", divide="ignore"):
+        sim = np.where(union > 0, s / union, 1.0)
+    return {"similarity": sim, "distance": _np_gower(sim)}
+
+
+def _jaccard_dual_operands(block):
+    import jax.numpy as jnp
+
+    c = (block >= 0).astype(jnp.float32)
+    t1 = (block >= 1).astype(jnp.float32)
+    return {"c": c, "t1": t1}
+
+
+_jaccard_dual_operands.operand_names = ("c", "t1")
+
+
+def _jaccard_cross_d2(acc):
+    import jax.numpy as jnp
+
+    # Cross union between a query row and a panel column: each side's
+    # carrier count over pairwise-complete variants, minus the shared
+    # carriers. Self-similarity is exactly 1 on both sides (see the
+    # symmetric finalize), so the Gower squared distance is 2 - 2J.
+    s = acc["s"].astype(jnp.float32)
+    union = (acc["sn"] + acc["sr"]).astype(jnp.float32) - s
+    sim = jnp.where(union > 0, s / union, 1.0)
+    return jnp.maximum(2.0 - 2.0 * sim, 0.0)
+
+
+register(Kernel(
+    name="jaccard",
+    summary="carrier-set Jaccard similarity over pairwise-complete "
+            "variants (duplicate detection / cohort dedup)",
+    family="count",
+    pieces=("t1c", "t1t1"),
+    stats=("s", "sc"),
+    finalize=_jaccard_finalize,
+    np_finalize=_jaccard_np_finalize,
+    pack_auto=True,
+    # The accumulated products are indicator sums (increment 1), but
+    # finalize computes union = sc + sc^T - s in int32 — the effective
+    # per-variant increment is 2, same reason ibs2/king register 2.
+    max_increment=2,
+    flops=_count_flops(("t1c", "t1t1")),
+    # Dual sketch: NUM = intersection counts T1 T1^T (PSD by
+    # construction — both rungs available); DEN = the union pair
+    # counts, whose Perron rank-1 factor the solver extracts from the
+    # den sketch. arXiv:1911.04200's communication-efficient Jaccard
+    # sketching, recast onto the streaming range-sketch machinery.
+    sketch=DualSketch(
+        operands=_jaccard_dual_operands,
+        num_terms=(("t1", "t1", 1.0),),
+        den_terms=(("t1", "c", 1.0), ("c", "t1", 1.0),
+                   ("t1", "t1", -1.0)),
+        num_psd=True,
+    ),
+    cross=CrossSpec(stats=("s", "sn", "sr"), d2=_jaccard_cross_d2),
+))
+
+
+# ---------------------------------------------------------------- grm
+
+def _grm_finalize(stats):
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops.distances import similarity_to_distance
+
+    g = stats["zz"] / jnp.maximum(stats["nvar"], 1.0)
+    return {"similarity": g, "distance": similarity_to_distance(g)}
+
+
+def _grm_np_finalize(acc):
+    import numpy as np
+
+    g = acc["zz"] / np.maximum(acc["nvar"], 1.0)
+    return {"similarity": g, "distance": _np_gower(g)}
+
+
+def _grm_init(n):
+    import jax.numpy as jnp
+
+    return {
+        "zz": jnp.zeros((n, n), jnp.float32),
+        "nvar": jnp.zeros((), jnp.float32),
+    }
+
+
+def _grm_update_impl(packed: bool):
+    from spark_examples_tpu.ops import gram
+
+    return gram._update_grm_packed_impl if packed else gram._update_grm_impl
+
+
+def _grm_tile_body(acc, block, i, j, tn, tm, precise):
+    """The GRM tile2d contribution: standardization statistics come
+    from the FULL block (per-variant, over all N samples — replicated
+    work, identical on every device), then only the tile's slices hit
+    the MXU."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops import gram as gram_ops
+
+    z, keep = gram_ops.grm_standardize(block, precise)
+    zr = jax.lax.dynamic_slice_in_dim(z, i * tn, tn, axis=0)
+    zc = jax.lax.dynamic_slice_in_dim(z, j * tm, tm, axis=0)
+    zz = jax.lax.dot_general(
+        zr, zc, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return {"zz": acc["zz"] + zz, "nvar": acc["nvar"] + keep.sum()}
+
+
+def _grm_features(block, precise):
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops import gram as gram_ops
+
+    # Same standardization as the exact route; the sketch's matmuls
+    # then run f32 regardless of grm_precise (they are ~N/r cheaper
+    # than the dense update, so there is no rate to buy back).
+    a, keep = gram_ops.grm_standardize(block, precise)
+    return a.astype(jnp.float32), keep.sum().astype(jnp.float32)
+
+
+def _grm_oracle(x):
+    from spark_examples_tpu.utils import oracle
+
+    return oracle.naive_grm(x)
+
+
+register(Kernel(
+    name="grm",
+    summary="VanRaden/GCTA genomic relationship matrix (f32 "
+            "accumulation, within-block allele frequencies)",
+    family="float",
+    finalize=_grm_finalize,
+    np_finalize=_grm_np_finalize,
+    pack_auto=True,
+    max_increment=None,  # f32 accumulation: rounding, not wraparound
+    flops=lambda n, v: 2.0 * n * n * v,  # one Z Z^T matmul per block
+    sketch=FactorSketch(features=_grm_features, uses_nvar=True),
+    acc_leaves_=("zz", "nvar"),
+    scalar_leaves=("nvar",),
+    init=_grm_init,
+    update_impl=_grm_update_impl,
+    tile_body=_grm_tile_body,
+    oracle_similarity=_grm_oracle,
+))
+
+
+# ---------------------------------------------------------- braycurtis
+
+def _braycurtis_runner(job, source, timer):
+    from spark_examples_tpu.pipelines import runner
+
+    return runner._run_braycurtis(job, source, timer)
+
+
+register(Kernel(
+    name="braycurtis",
+    summary="abundance-table Bray-Curtis dissimilarity (dense-table "
+            "path, not the gram accumulator)",
+    family="table",
+    pack_auto=False,
+    # Elementwise |a-b| / (a+b) over all pairs: ~3 N^2 F VPU ops for
+    # the exact lowering (the matmul/pallas lowerings trade this for
+    # MXU work; see ops/distances.py).
+    flops=lambda n, f: 3.0 * n * n * f,
+    table_runner=_braycurtis_runner,
+))

@@ -23,60 +23,24 @@ def finalize(acc: dict, metric: str) -> dict[str, jnp.ndarray]:
 
     Combines the streamed int32 matmul products into named statistics
     (integer-exact — :func:`spark_examples_tpu.ops.gram.combine`), then
-    applies the metric's ratio/transform. IBS semantics follow the PLINK
-    convention the reference family used: over pairwise-complete
-    variants, ``distance = sum|a-b| / (2 * m)`` and ``similarity = 1 -
-    distance``; pairs with zero shared valid variants get distance 0
-    (they cannot be distinguished from identical — the oracle encodes the
-    same choice so parity tests pin it down).
+    applies the kernel's declared finalize (its ratio/transform —
+    spark_examples_tpu/kernels, each registration documents its
+    conventions; e.g. IBS follows the PLINK convention the reference
+    family used: ``distance = sum|a-b| / (2 * m)`` over pairwise-
+    complete variants, zero-overlap pairs -> distance 0, and the CPU
+    oracle mirrors the same choices via the kernel's ``np_finalize``).
     """
+    from spark_examples_tpu import kernels
     from spark_examples_tpu.ops import gram
 
+    kern = kernels.maybe_get(metric)
+    if kern is None or kern.finalize is None:
+        raise ValueError(
+            f"unknown metric {metric!r}; finalizable kernels: "
+            f"{' | '.join(sorted(kernels.gram_names()))}"
+        )
     stats = gram.combine(acc, metric)
-    if metric == "ibs":
-        m = stats["m"]
-        dist = jnp.where(m > 0, stats["d1"] / (2.0 * m), 0.0)
-        return {"similarity": 1.0 - dist, "distance": dist}
-    if metric == "ibs2":
-        m = stats["m"]
-        sim = jnp.where(m > 0, stats["ibs2"] / (1.0 * m), 1.0)
-        return {"similarity": sim, "distance": 1.0 - sim}
-    if metric == "shared-alt":
-        # The reference PCA driver's similarity: raw shared-alt-carrier
-        # counts (centering happens downstream, SURVEY.md §3.1).
-        s = stats["s"].astype(jnp.float32)
-        return {"similarity": s, "distance": similarity_to_distance(s)}
-    if metric == "euclidean":
-        d = jnp.sqrt(jnp.maximum(stats["e2"].astype(jnp.float32), 0.0))
-        return {"similarity": -d, "distance": d}
-    if metric == "grm":
-        g = stats["zz"] / jnp.maximum(stats["nvar"], 1.0)
-        return {"similarity": g, "distance": similarity_to_distance(g)}
-    if metric == "dot":
-        dot = stats["dot"].astype(jnp.float32)
-        return {"similarity": dot, "distance": similarity_to_distance(dot)}
-    if metric == "king":
-        # KING-robust kinship (Manichaikul 2010, between-family form):
-        # phi = (N_AaAa - 2 * N_AA,aa) / (N_Aa(i) + N_Aa(j)), hets
-        # counted over pairwise-complete variants. The diagonal lands on
-        # 0.5 by construction (hc_ii == hh_ii). Pairs sharing no het
-        # variants are uninformative -> phi 0 (unrelated), same spirit
-        # as ibs's zero-overlap convention.
-        den = (stats["hc"] + stats["hc"].T).astype(jnp.float32)
-        num = (stats["hh"] - 2 * stats["opp"]).astype(jnp.float32)
-        phi = jnp.where(den > 0, num / den, 0.0)
-        # Pin the diagonal to self-kinship 0.5 even for samples with
-        # zero het calls (inbred lines, haploid 0/2 coding), whose
-        # den_ii = 0 would otherwise fall into the "unrelated" branch —
-        # and a nonzero self-distance would poison the Gower centering
-        # every downstream PCoA applies.
-        n = phi.shape[0]
-        phi = jnp.where(jnp.eye(n, dtype=bool), 0.5, phi)
-        # Kinship distance: 0.5 - phi (0 for self/MZ, ~0.5 unrelated,
-        # clipped: sampling noise can push phi past the 0.5 bound).
-        return {"similarity": phi,
-                "distance": jnp.maximum(0.5 - phi, 0.0)}
-    raise ValueError(f"unknown metric {metric!r}")
+    return kern.finalize(stats)
 
 
 def similarity_to_distance(s: jnp.ndarray) -> jnp.ndarray:

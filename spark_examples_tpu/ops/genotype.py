@@ -74,6 +74,7 @@ _INT8_SPLIT: dict[str, tuple[tuple[tuple[str, str], int], ...]] = {
 PIECE_PRODUCTS: dict[str, tuple[str, ...]] = {
     "m": ("cc",),
     "s": ("t1t1",),
+    "sc": ("t1c",),
     "d1": ("yc", "t1t1", "t2t2"),
     "ibs2": ("cc", "t1c", "t1t1", "t1t2", "t2t2"),
     "dot": ("yy",),
@@ -269,6 +270,10 @@ def combine_products(
             out["m"] = prod["cc"]
         elif piece == "s":
             out["s"] = prod["t1t1"]
+        elif piece == "sc":
+            # sc[i, j] = # variants where i carries alt AND j's call is
+            # valid (non-symmetric; the jaccard union is sc + sc^T - s)
+            out["sc"] = prod["t1c"]
         elif piece == "d1":
             p = prod["t1t1"] + prod["t2t2"]
             out["d1"] = prod["yc"] + _t(prod["yc"]) - 2 * p
@@ -333,6 +338,10 @@ CROSS_STATS: dict[str, tuple[tuple[tuple[str, str], int], ...]] = {
             (("t2", "c"), 1), (("t2", "t1"), -1)),
     "hcn": ((("t1", "c"), 1), (("t2", "c"), -1)),
     "hcr": ((("c", "t1"), 1), (("c", "t2"), -1)),
+    # jaccard union sides: each cohort's carrier count over pairwise-
+    # complete variants (union = sn + sr - s).
+    "sn": ((("t1", "c"), 1),),
+    "sr": ((("c", "t1"), 1),),
 }
 
 

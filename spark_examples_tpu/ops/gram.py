@@ -19,104 +19,98 @@ one block plus the N x N state (SURVEY.md §5 "Long-context").
 
 Two block transforms live here:
 
-- :func:`update` / :func:`update_packed` — raw-product accumulation for
-  the counting metrics (IBS / shared-alt / euclidean / IBS2 families, all
-  pairwise-complete over missing data);
-- :func:`update_grm` — the standardized-dosage GRM (VanRaden/GCTA form):
+- :func:`update` / :func:`update_packed` — per-kernel accumulation:
+  raw products for the counting family (IBS / shared-alt / euclidean /
+  IBS2 families, all pairwise-complete over missing data), the kernel's
+  declared float update for the float family (GRM: VanRaden/GCTA form —
   per-variant allele frequency estimated *within the block*, dosages
   centered by 2p and scaled by 1/sqrt(2p(1-p)), missing mean-imputed to
-  zero contribution, accumulated as Z Z^T in f32.
+  zero contribution, accumulated as Z Z^T in f32).
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 
+from spark_examples_tpu import kernels
 from spark_examples_tpu.core.dtypes import COMPUTE_DTYPE
 from spark_examples_tpu.ops import genotype
 
-# Which raw matmul products each metric accumulates. Each product is one
-# int8 x int8 -> int32 dot; the per-metric statistic is assembled from
-# them once, in combine().
+# Which raw matmul products each counting metric accumulates — DERIVED
+# from the kernel registry (spark_examples_tpu/kernels), the single
+# source of truth. Each product is one int8 x int8 -> int32 dot; the
+# per-metric statistic is assembled from them once, in combine().
+# NOTE: these module-level dicts are an import-time VIEW for
+# introspection (tests, bench, shardings); the dispatch functions below
+# read the live registry through _check_metric, so a kernel registered
+# after this module imported still routes correctly.
 PIECES_FOR_METRIC: dict[str, tuple[str, ...]] = {
-    "ibs": ("cc", "yc", "t1t1", "t2t2"),
-    "ibs2": ("cc", "t1c", "t1t1", "t1t2", "t2t2"),
-    "shared-alt": ("t1t1",),
-    "euclidean": ("qc", "yy"),
-    "dot": ("yy",),
-    "king": ("t1c", "t2c", "t1t1", "t1t2", "t2t2"),
+    k.name: k.pieces for k in kernels.all_kernels() if k.family == "count"
 }
 
 # Statistics (genotype.combine_products names) each metric's finalize needs.
 STATS_FOR_METRIC: dict[str, tuple[str, ...]] = {
-    "ibs": ("m", "d1"),
-    "ibs2": ("m", "ibs2"),
-    "shared-alt": ("s",),
-    "euclidean": ("e2",),
-    "dot": ("dot",),
-    "king": ("hh", "opp", "hc"),
+    k.name: k.stats for k in kernels.all_kernels() if k.family == "count"
 }
 
-GRAM_METRICS = tuple(PIECES_FOR_METRIC) + ("grm",)
+GRAM_METRICS = kernels.gram_names()
 
 # Metrics whose inputs are genotype dosages *by definition* — safe to ship
-# 2-bit packed under pack_stream="auto". dot/euclidean compute exact
-# raw-value products for arbitrary int8 tables (values >= 0; negatives are
-# missing), which the 2-bit codec cannot represent, so auto keeps them on
-# the dense transport.
-DOSAGE_METRICS = ("ibs", "ibs2", "shared-alt", "grm", "king")
+# 2-bit packed under pack_stream="auto" (the kernel's pack_auto flag).
+# dot/euclidean compute exact raw-value products for arbitrary int8
+# tables (values >= 0; negatives are missing), which the 2-bit codec
+# cannot represent, so auto keeps them on the dense transport.
+DOSAGE_METRICS = tuple(
+    k.name for k in kernels.all_kernels() if k.is_gram and k.pack_auto
+)
 
 # int32 accumulator budget: worst per-variant increment by metric, for
 # the runner's exactness guard (increment * n_variants must stay < 2^31).
-# dot/euclidean depend on the table's max value m (bound m^2); the value
-# here is the dosage-domain bound, the runner scales it by the observed
-# max when the stream is dense.
+# Kernels with value_scaled_budget (dot/euclidean) depend on the table's
+# max value m (bound m^2); the registered value is the dosage-domain
+# bound, the runner scales it by the observed max when the stream is
+# dense. Float-accumulating kernels (grm) are exempt (absent here).
 MAX_INCREMENT: dict[str, int] = {
-    "ibs": 2,        # yc with y <= 2
-    "ibs2": 2,       # t1c-family indicator sums
-    "shared-alt": 1,
-    "euclidean": 4,  # qc/yy at dosage values; m^2 in general
-    "dot": 4,
-    "king": 2,       # finalize sums hc + hc^T / hh - 2*opp in int32
+    k.name: k.max_increment for k in kernels.all_kernels()
+    if k.max_increment is not None
 }
 
 
 def flops_per_block(n: int, v: int, metric: str) -> float:
-    """Matmul FLOPs one block contributes (for GFLOPS reporting).
-
-    Counts the matmuls the integer TPU path actually runs: products in
-    ``genotype._INT8_SPLIT`` (the radix-128 ``qc`` lowering) cost one
-    matmul per split term, so euclidean is 3, not 2.
-    """
-    n_matmuls = sum(
-        len(genotype._INT8_SPLIT.get(p, (None,)))
-        for p in PIECES_FOR_METRIC.get(metric, ("zz",))
-    )
-    return 2.0 * n * n * v * n_matmuls
+    """Matmul FLOPs one block contributes (for GFLOPS reporting) — the
+    kernel's declared FLOPs model (for counting kernels: one matmul per
+    ``genotype._INT8_SPLIT`` term of each product, so euclidean is 3,
+    not 2)."""
+    kern = kernels.maybe_get(metric)
+    if kern is None or kern.flops is None:
+        return 2.0 * n * n * v  # one plain matmul (legacy fallback)
+    return kern.flops(n, v)
 
 
-def _check_metric(metric: str) -> None:
-    if metric not in GRAM_METRICS:
+def _check_metric(metric: str) -> "kernels.Kernel":
+    kern = kernels.maybe_get(metric)
+    if kern is None or not kern.is_gram:
         raise ValueError(
             f"unknown gram metric {metric!r}; valid: {sorted(GRAM_METRICS)} "
             "(braycurtis runs via distances.braycurtis, not the gram path)"
         )
+    return kern
+
+
+def acc_leaves(metric: str) -> tuple[str, ...]:
+    """Accumulator leaf names for a gram metric (checkpoint schema)."""
+    return _check_metric(metric).acc_leaves
 
 
 def init(n: int, metric: str) -> dict[str, jnp.ndarray]:
     """Fresh zero accumulators for ``metric`` on the default device."""
-    _check_metric(metric)
-    if metric == "grm":
-        return {
-            "zz": jnp.zeros((n, n), jnp.float32),
-            "nvar": jnp.zeros((), jnp.float32),
-        }
-    return {
-        k: jnp.zeros((n, n), jnp.int32) for k in PIECES_FOR_METRIC[metric]
-    }
+    kern = _check_metric(metric)
+    if kern.family == "float":
+        return kern.init(n)
+    return {k: jnp.zeros((n, n), jnp.int32) for k in kern.pieces}
 
 
 def _update_impl(acc, block, pieces: tuple[str, ...]):
@@ -184,12 +178,11 @@ def impl_for(metric: str, packed: bool, grm_precise: bool = False):
     MXU rate, ~1e-3 better relative accuracy); ignored by the exact
     integer metrics.
     """
-    _check_metric(metric)
-    if metric == "grm":
-        impl = _update_grm_packed_impl if packed else _update_grm_impl
-        return partial(impl, precise=grm_precise)
+    kern = _check_metric(metric)
+    if kern.family == "float":
+        return partial(kern.update_impl(packed), precise=grm_precise)
     impl = _update_packed_impl if packed else _update_impl
-    return partial(impl, pieces=PIECES_FOR_METRIC[metric])
+    return partial(impl, pieces=kern.pieces)
 
 
 _update = partial(jax.jit, static_argnames=("pieces",), donate_argnums=(0,))(
@@ -198,35 +191,38 @@ _update = partial(jax.jit, static_argnames=("pieces",), donate_argnums=(0,))(
 _update_packed = partial(
     jax.jit, static_argnames=("pieces",), donate_argnums=(0,)
 )(_update_packed_impl)
-update_grm = partial(jax.jit, static_argnames=("precise",), donate_argnums=(0,))(
-    _update_grm_impl
-)
-update_grm_packed = partial(
-    jax.jit, static_argnames=("precise",), donate_argnums=(0,)
-)(_update_grm_packed_impl)
+@lru_cache(maxsize=32)
+def _float_update_jit(metric: str, packed: bool):
+    """Jitted, donating convenience update for a float-family kernel —
+    built from the kernel's declared impl, so a second float kernel
+    gets the same jit/donation treatment as grm with no literal here."""
+    return partial(jax.jit, static_argnames=("precise",),
+                   donate_argnums=(0,))(_check_metric(metric)
+                                        .update_impl(packed))
 
 
 def update(acc: dict, block: jnp.ndarray, metric: str) -> dict:
     """Add one (N, v_blk) int8 dosage block's contribution to ``acc``."""
-    _check_metric(metric)
-    if metric == "grm":
-        return update_grm(acc, block)
-    return _update(acc, block, PIECES_FOR_METRIC[metric])
+    kern = _check_metric(metric)
+    if kern.family == "float":
+        return _float_update_jit(metric, False)(acc, block)
+    return _update(acc, block, kern.pieces)
 
 
 def update_packed(acc: dict, packed: jnp.ndarray, metric: str) -> dict:
     """Packed-block twin of :func:`update`."""
-    _check_metric(metric)
-    if metric == "grm":
-        return update_grm_packed(acc, packed)
-    return _update_packed(acc, packed, PIECES_FOR_METRIC[metric])
+    kern = _check_metric(metric)
+    if kern.family == "float":
+        return _float_update_jit(metric, True)(acc, packed)
+    return _update_packed(acc, packed, kern.pieces)
 
 
 def combine(acc: dict, metric: str) -> dict[str, jnp.ndarray]:
     """Accumulated raw products -> the named statistics ``finalize``
-    consumes (integer-exact; runs once per job). GRM accumulators pass
-    through unchanged (already in statistic form)."""
-    _check_metric(metric)
-    if metric == "grm":
+    consumes (integer-exact; runs once per job). Float-family kernels'
+    accumulators (GRM) pass through unchanged (already in statistic
+    form)."""
+    kern = _check_metric(metric)
+    if kern.family == "float":
         return acc
-    return genotype.combine_products(acc, STATS_FOR_METRIC[metric])
+    return genotype.combine_products(acc, kern.stats)

@@ -50,6 +50,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_examples_tpu import kernels
 from spark_examples_tpu.core import meshes
 from spark_examples_tpu.ops import gram as gram_ops
 
@@ -126,7 +127,10 @@ def plan_for(
     """Pick a distribution mode (or validate a forced one)."""
     if mode == "auto":
         n_dev = mesh.devices.size
-        n_acc = max(len(gram_ops.PIECES_FOR_METRIC.get(metric, ("zz",))), 1)
+        kern = kernels.maybe_get(metric)
+        # N x N leaves only — scalar leaves (grm's nvar) are noise.
+        n_acc = (max(len(kern.acc_leaves) - len(kern.scalar_leaves), 1)
+                 if kern is not None else 1)
         acc_bytes = 4 * n_samples * n_samples * n_acc
         if n_dev == 1:
             mode = "replicated"
@@ -142,11 +146,15 @@ def plan_for(
 
 
 def _acc_shardings(plan: GramPlan, metric: str):
-    """Per-leaf shardings for the accumulator pytree (GRM has a scalar)."""
-    if metric == "grm":
-        return {"zz": plan.acc_sharding, "nvar": plan.scalar_sharding}
-    pieces = gram_ops.PIECES_FOR_METRIC[metric]
-    return {k: plan.acc_sharding for k in pieces}
+    """Per-leaf shardings for the accumulator pytree — N x N leaves take
+    the plan's accumulator layout, the kernel's declared scalar leaves
+    (e.g. the GRM's nvar) stay replicated."""
+    kern = kernels.get(metric)
+    return {
+        k: (plan.scalar_sharding if k in kern.scalar_leaves
+            else plan.acc_sharding)
+        for k in kern.acc_leaves
+    }
 
 
 def init_sharded(plan: GramPlan, n: int, metric: str):
@@ -193,13 +201,12 @@ def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
 
     mesh = plan.mesh
     n_i, n_j = mesh.devices.shape
-    if metric == "grm":
-        acc_specs = {"zz": P(meshes.AXIS_I, meshes.AXIS_J), "nvar": P()}
-    else:
-        acc_specs = {
-            k: P(meshes.AXIS_I, meshes.AXIS_J)
-            for k in gram_ops.PIECES_FOR_METRIC[metric]
-        }
+    kern = kernels.get(metric)
+    acc_specs = {
+        k: (P() if k in kern.scalar_leaves
+            else P(meshes.AXIS_I, meshes.AXIS_J))
+        for k in kern.acc_leaves
+    }
     block_spec = (
         P(None, (meshes.AXIS_I, meshes.AXIS_J)) if gather_block else P()
     )
@@ -220,18 +227,12 @@ def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
         n = block.shape[0]
         check_tile_divisible(n, mesh)  # trace-time; shapes are concrete
         tn, tm = n // n_i, n // n_j
-        if metric == "grm":
-            # Standardization statistics come from the FULL block (per-
+        if kern.family == "float":
+            # Float-family kernels (GRM) supply their own tile body —
+            # e.g. standardization statistics from the FULL block (per-
             # variant, over all N samples — replicated work, identical
-            # on every device), then only the tile's slices hit the MXU.
-            z, keep = gram_ops.grm_standardize(block, grm_precise)
-            zr = jax.lax.dynamic_slice_in_dim(z, i * tn, tn, axis=0)
-            zc = jax.lax.dynamic_slice_in_dim(z, j * tm, tm, axis=0)
-            zz = jax.lax.dot_general(
-                zr, zc, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return {"zz": acc["zz"] + zz, "nvar": acc["nvar"] + keep.sum()}
+            # on every device), then only the tile's slices on the MXU.
+            return kern.tile_body(acc, block, i, j, tn, tm, grm_precise)
         rows = jax.lax.dynamic_slice_in_dim(block, i * tn, tn, axis=0)
         cols = jax.lax.dynamic_slice_in_dim(block, j * tm, tm, axis=0)
         prods = genotype.tile_products(rows, cols, tuple(acc_specs))

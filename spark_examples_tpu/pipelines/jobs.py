@@ -232,8 +232,12 @@ def _pcoa_device_route(job: JobConfig, source, timer) -> CoordsOutput | None:
 
     cfg = job.compute
     metric = cfg.metric or "ibs"
-    if cfg.backend == "cpu-reference" or metric == "braycurtis":
+    if cfg.backend == "cpu-reference":
         return None
+    from spark_examples_tpu import kernels
+
+    if not kernels.get(metric).is_gram:
+        return None  # table-family kernels take the dense host route
     plan = runner.plan_for_job(job, source)
     if plan.mode == "tile2d" and cfg.eigh_mode == "dense":
         return None  # dense eigh requires the materialized matrix

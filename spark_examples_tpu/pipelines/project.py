@@ -22,11 +22,15 @@ reference+new moves every axis. This module adds that second half:
    their fitted coordinates exactly (B V = V diag(lambda)), which is the
    invariant the tests pin.
 
-Two model kinds are projectable: PCoA over ``ibs`` distances (the Gower
-extension above) and the flagship PCA over shared-alt similarities
-(``pca --save-model``; a new row's cross similarity is centered with
-the reference's column/grand means and projected onto V — training
-rows reproduce their fitted coordinates exactly, since C V = V Λ).
+Projectability is a KERNEL capability: a gram-path kernel declaring a
+:class:`spark_examples_tpu.kernels.CrossSpec` (the cross statistics to
+stream plus the squared-distance finalize — ibs and jaccard today) is
+projectable as a PCoA model through the Gower extension above, with no
+changes here. The flagship PCA over shared-alt similarities stays its
+own kind (``pca --save-model``; a new row's cross similarity is
+centered with the reference's column/grand means and projected onto V
+— training rows reproduce their fitted coordinates exactly, since
+C V = V Λ).
 
 The long-lived ONLINE counterpart of this module is
 ``spark_examples_tpu/serve/``: the serving engine stages the panel
@@ -49,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_examples_tpu import kernels
 from spark_examples_tpu.core import meshes
 from spark_examples_tpu.core.config import JobConfig
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
@@ -61,9 +66,13 @@ from spark_examples_tpu.pipelines.jobs import CoordsOutput
 # (model kind, metric) -> cross statistics to stream. Keyed on BOTH: a
 # shared-alt PCoA model (valid to fit) is not projectable — gating on
 # metric alone would pass it through and crash after the expensive
-# cross-stream pass.
+# cross-stream pass. The pcoa rows are DERIVED from the kernel
+# registry: any kernel declaring a CrossSpec is servable/projectable,
+# so adding one never touches this module. PCA keeps its dedicated
+# similarity-projection row.
 PROJECTABLE = {
-    ("pcoa", "ibs"): ("m", "d1"),
+    **{("pcoa", k.name): k.cross.stats
+       for k in kernels.all_kernels() if k.cross is not None},
     ("pca", "shared-alt"): ("s",),
 }
 
@@ -718,10 +727,14 @@ def cross_kinship_job(job, source_new, source_ref):
     )
 
 
-@partial(jax.jit, static_argnames=())
-def _project(m, d1, d2_colmean, d2_grand, eigvecs, eigvals):
-    dist = jnp.where(m > 0, d1.astype(jnp.float32) / (2.0 * m), 0.0)
-    d2 = dist * dist
+@partial(jax.jit, static_argnames=("metric",))
+def _project(acc, d2_colmean, d2_grand, eigvecs, eigvals, metric):
+    """Gower out-of-sample projection: the kernel's declared cross
+    squared-distance (``CrossSpec.d2`` — e.g. ibs's ``(d1/2m)^2``,
+    jaccard's ``2 - 2J``) centered with the reference statistics, then
+    projected onto the fitted eigenvectors. ``metric`` is static — each
+    projectable kernel compiles its own finalize once."""
+    d2 = kernels.get(metric).cross.d2(acc)
     b = -0.5 * (
         d2
         - d2.mean(axis=1, keepdims=True)
@@ -775,8 +788,8 @@ def pcoa_project_job(
     acc, n_variants = _accumulate_cross(
         job, source_new, source_ref, stats, timer
     )
-    # Same int32-exactness guard as the symmetric path (d1's increment
-    # bound is MAX_INCREMENT['ibs']); warns when counts may have wrapped.
+    # Same int32-exactness guard as the symmetric path (the kernel's
+    # registered increment bound); warns when counts may have wrapped.
     R._check_int32_budget(metric, n_variants, 2)
     # One fused device step: finalize cross statistics + out-of-sample
     # centering + eigvec products; only the (A, k) coordinates come home.
@@ -787,8 +800,8 @@ def pcoa_project_job(
             )))
         else:
             coords = np.asarray(hard_sync(_project(
-                acc["m"], acc["d1"], center_stats[0], center_stats[1],
-                eigvecs, eigvals
+                acc, center_stats[0], center_stats[1],
+                eigvecs, eigvals, metric=metric,
             )))
     out = CoordsOutput(source_new.sample_ids, coords,
                        np.asarray(eigvals), timer, n_variants)

@@ -25,6 +25,7 @@ from functools import partial
 import jax
 import numpy as np
 
+from spark_examples_tpu import kernels
 from spark_examples_tpu.core import checkpoint as ckpt
 from spark_examples_tpu.core import meshes, telemetry
 from spark_examples_tpu.core.config import IngestConfig, JobConfig
@@ -293,11 +294,12 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
         plan = plan_for_job(job, source)
     if cfg.pack_stream not in ("auto", "packed", "dense"):
         raise ValueError(f"unknown pack_stream {cfg.pack_stream!r}")
-    # auto: pack only metrics whose inputs are dosages by definition —
-    # dot/euclidean accept arbitrary int8 tables the 2-bit codec cannot
-    # represent.
+    # auto: pack only kernels declaring pack_auto (inputs are dosages
+    # by definition) — dot/euclidean accept arbitrary int8 tables the
+    # 2-bit codec cannot represent, and their registrations say so.
+    kern = kernels.get(metric)
     packed = cfg.pack_stream == "packed" or (
-        cfg.pack_stream == "auto" and metric in gram.DOSAGE_METRICS
+        cfg.pack_stream == "auto" and kern.pack_auto
     )
     update = gram_sharded.make_update(
         plan, metric, packed=packed, grm_precise=cfg.grm_precise
@@ -306,11 +308,11 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
     bv = job.ingest.block_variants
     start_variant = 0
     acc = None
-    # Only dot/euclidean consume the producer-side max (their int32
-    # budget depends on the table's values); other metrics skip the
-    # per-block host scan entirely.
+    # Only kernels whose int32 budget scales with the table's values
+    # (dot/euclidean: value_scaled_budget) consume the producer-side
+    # max; other metrics skip the per-block host scan entirely.
     stream_stats: dict | None = (
-        {} if metric in ("dot", "euclidean") and not packed else None
+        {} if kern.value_scaled_budget and not packed else None
     )
     if cfg.checkpoint_dir:
         restored = ckpt.load(cfg.checkpoint_dir, metric, source.sample_ids,
@@ -530,8 +532,12 @@ def run_similarity(job: JobConfig, source=None) -> SimilarityResult:
             source = build_source(job.ingest)
     metric = cfg.metric or "ibs"  # None -> driver default
 
-    if metric == "braycurtis":
-        return _run_braycurtis(job, source, timer)
+    # Table-family kernels (braycurtis) carry their own dense-table
+    # runner instead of riding the gram accumulator — the registry
+    # capability flag, so adding one never touches this dispatch.
+    table = kernels.get(metric).table_runner
+    if table is not None:
+        return table(job, source, timer)
 
     if cfg.backend == "cpu-reference":
         return _run_similarity_cpu(job, source, timer)
@@ -555,16 +561,18 @@ def _check_int32_budget(metric: str, n_variants: int, max_value: int) -> None:
     """Warn when a stream outruns the int32 accumulators' exactness bound.
 
     Counts are bit-exact while worst-per-variant-increment * n_variants
-    < 2^31 (ops/genotype.py): dosage metrics have fixed increment bounds
-    (gram.MAX_INCREMENT); dot/euclidean on arbitrary int8 tables are
-    bounded by max_value^2 (tracked by the prefetch producer). GRM
-    accumulates in f32 — rounding, not wraparound, is its failure mode —
-    so it is exempt.
+    < 2^31 (ops/genotype.py): each kernel registers its increment bound
+    (gram.MAX_INCREMENT, from the registry); kernels with
+    value_scaled_budget (dot/euclidean on arbitrary int8 tables) are
+    bounded by max_value^2 (tracked by the prefetch producer). Float-
+    accumulating kernels (GRM) — rounding, not wraparound, is their
+    failure mode — register no bound and are exempt.
     """
-    if metric not in gram.MAX_INCREMENT:
+    kern = kernels.maybe_get(metric)
+    if kern is None or kern.max_increment is None:
         return
-    inc = gram.MAX_INCREMENT[metric]
-    if metric in ("dot", "euclidean"):
+    inc = kern.max_increment
+    if kern.value_scaled_budget:
         inc = max(inc, max(1, int(max_value)) ** 2)
     if inc * n_variants >= 2**31:
         import warnings
@@ -640,10 +648,14 @@ def _run_similarity_cpu(job: JobConfig, source, timer: PhaseTimer) -> Similarity
     """The measured CPU baseline (stand-in for Spark MLlib, SURVEY.md §5)."""
     metric = job.compute.metric or "ibs"
     n = source.n_samples
-    if metric == "grm":
+    kern = kernels.get(metric)
+    if kern.family == "float":
+        # Float-family kernels carry their own whole-matrix oracle
+        # (the GRM's within-matrix allele frequencies need the full
+        # table, not additive raw products).
         with timer.phase("gram"):
             x = _materialize(source, job.ingest.block_variants)
-            g = oracle.naive_grm(x)
+            g = kern.oracle_similarity(x)
         return SimilarityResult(
             similarity=g,
             distance=np.asarray(distances.similarity_to_distance(g)),

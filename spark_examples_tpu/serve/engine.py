@@ -279,6 +279,7 @@ class ProjectionEngine:
                 self._eigvecs,
             )
         return P._project(
-            acc["m"][i:i + 1], acc["d1"][i:i + 1], self._colmean,
+            {k: v[i:i + 1] for k, v in acc.items()}, self._colmean,
             self._grand, self._eigvecs, self._eigvals,
+            metric=self.model.metric,
         )

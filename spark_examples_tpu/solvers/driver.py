@@ -31,11 +31,11 @@ from dataclasses import dataclass
 import jax
 import numpy as np
 
+from spark_examples_tpu import kernels
 from spark_examples_tpu.core import checkpoint as ckpt
 from spark_examples_tpu.core import meshes, telemetry
 from spark_examples_tpu.core.config import SOLVER_RUNG_ID, JobConfig
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
-from spark_examples_tpu.ops import gram
 from spark_examples_tpu.ops.eigh import coords_from_eigpairs
 from spark_examples_tpu.parallel.gram_sharded import GramPlan
 from spark_examples_tpu.pipelines import runner as R
@@ -74,7 +74,10 @@ def sketch_plan(job: JobConfig) -> GramPlan:
 
 def run_sketch_solve(job: JobConfig, source, timer: PhaseTimer,
                      kind: str) -> SketchSolveResult:
-    """Run the full sketch/corrected solve for a pcoa or pca job."""
+    """Run the full sketch/corrected solve for a pcoa or pca job —
+    dispatching on the kernel's declared streamability: a FactorSketch
+    runs the PR-7 single-factor construction below; a DualSketch (ratio
+    metrics: ibs, jaccard) runs :func:`_run_dual_solve`."""
     cfg = job.compute
     metric = "shared-alt" if kind == "pca" else (cfg.metric or "ibs")
     sketch.check_sketchable(metric, cfg.solver)
@@ -96,12 +99,16 @@ def run_sketch_solve(job: JobConfig, source, timer: PhaseTimer,
             "state psums span the local mesh); run multi-host jobs with "
             "--solver exact"
         )
+    kern = kernels.get(metric)
+    spec = kern.sketch
+    if isinstance(spec, kernels.DualSketch):
+        return _run_dual_solve(job, source, timer, kind, metric, plan)
     n = source.n_samples
     rank = min(cfg.sketch_rank, n)
     passes = 1 + (cfg.sketch_iters if cfg.solver == "corrected" else 0)
-    is_grm = metric == "grm"
+    is_grm = spec.uses_nvar
     packed = cfg.pack_stream == "packed" or (
-        cfg.pack_stream == "auto" and metric in gram.DOSAGE_METRICS
+        cfg.pack_stream == "auto" and kern.pack_auto
     )
     update = sketch.make_update(plan, metric, packed=packed,
                                 grm_precise=cfg.grm_precise)
@@ -110,6 +117,8 @@ def run_sketch_solve(job: JobConfig, source, timer: PhaseTimer,
     # dense route would have had to allocate for the same cohort.
     telemetry.gauge_set("solver.rung", RUNG_ID[cfg.solver])
     telemetry.gauge_set("solver.rank", float(rank))
+    telemetry.gauge_set("solver.dual", 0.0)
+    telemetry.gauge_set("solver.dual_den_defect", 0.0)  # n/a here; unstale
     telemetry.gauge_set("solver.state_bytes",
                         float(sketch.state_bytes(n, rank)))
     telemetry.gauge_set("solver.nxn_bytes_avoided",
@@ -193,6 +202,155 @@ def run_sketch_solve(job: JobConfig, source, timer: PhaseTimer,
         eigenvalues=vals_np,
         coords=coords,
         proportion=prop,
+        n_variants=n_variants,
+        rung=cfg.solver,
+        rank=int(rank),
+        passes=passes,
+    )
+
+
+_DUAL_CKPT_LEAVES = sketch.DUAL_STATE_LEAVES + ("passno",)
+
+
+def _run_dual_solve(job: JobConfig, source, timer: PhaseTimer, kind: str,
+                    metric: str, plan: GramPlan) -> SketchSolveResult:
+    """The dual-sketch solve for ratio metrics (similarity = NUM ⊘ DEN):
+    pass 0 streams BOTH the numerator and the pair-count-denominator
+    sketches in one variant pass (same staged-ring feed, ``gram.block``
+    spans, cursors, and checkpoint cadence as every other streamed
+    job); the denominator's dominant Perron rank-1 factor ``a a^T`` is
+    then extracted from ITS sketch, and the solve targets the scaled
+    operator ``B = J diag(1/a) NUM diag(1/a) J ~ J (NUM ⊘ DEN) J`` —
+    exact when DEN is rank-1 (e.g. IBS pair counts with no missing
+    calls). Corrected-rung passes are TRUE power steps of B (the scale
+    folds into the streamed probes), ending in a Rayleigh solve; the
+    single-pass rung (PSD numerators only — the registry's ``num_psd``
+    gate) solves from the congruence-transformed Nystrom factorization.
+
+    Geometry note: B embeds the **Gower geometry of the similarity**
+    (squared distance ``s_ii + s_jj - 2 s_ij``). For kernels whose
+    distance convention IS the Gower transform (jaccard), the rungs
+    converge to the exact route's PCoA; for ibs — whose native distance
+    is ``d1/2m`` directly — the sketch embeds the monotone-transformed
+    ``sqrt(2 * dist)`` geometry instead (same ordering, same structure
+    recovery; README 'Solvers & the accuracy ladder').
+
+    Proportion-explained is None: the scaled operator's total inertia
+    is not streamable before the scale exists, and a made-up
+    denominator would be dishonest.
+    """
+    cfg = job.compute
+    n = source.n_samples
+    rank = min(cfg.sketch_rank, n)
+    passes = 1 + (cfg.sketch_iters if cfg.solver == "corrected" else 0)
+    kern = kernels.get(metric)
+    packed = cfg.pack_stream == "packed" or (
+        cfg.pack_stream == "auto" and kern.pack_auto
+    )
+    # Pass 0 streams num + den + exact diagonal; later passes are pure
+    # power steps of the scaled operator and stream the numerator only.
+    updates = {
+        True: sketch.make_dual_update(plan, metric, packed=packed,
+                                      with_den=True),
+        False: sketch.make_dual_update(plan, metric, packed=packed,
+                                       with_den=False),
+    }
+
+    telemetry.gauge_set("solver.rung", RUNG_ID[cfg.solver])
+    telemetry.gauge_set("solver.rank", float(rank))
+    telemetry.gauge_set("solver.dual", 1.0)
+    telemetry.gauge_set("solver.dual_den_defect", 0.0)  # real value after pass 0
+    telemetry.gauge_set("solver.state_bytes",
+                        float(sketch.dual_state_bytes(n, rank)))
+    telemetry.gauge_set("solver.nxn_bytes_avoided",
+                        float(sketch.nxn_bytes(n, metric)))
+
+    metric_tag = f"solver:{metric}"
+    extra = {"solver": cfg.solver, "kind": kind, "rank": int(rank),
+             "iters": int(cfg.sketch_iters), "seed": int(cfg.sketch_seed),
+             "dual": True}
+    bv = job.ingest.block_variants
+
+    def save_state(state: dict, cursor: int, pass_idx: int) -> None:
+        acc = dict(state)
+        acc["passno"] = np.int64(pass_idx)
+        ckpt.save(cfg.checkpoint_dir, acc, cursor, metric_tag, bv,
+                  source.sample_ids, extra=extra)
+
+    state, start_pass, start_variant = None, 0, 0
+    if cfg.checkpoint_dir:
+        restored = ckpt.load(cfg.checkpoint_dir, metric_tag,
+                             source.sample_ids, block_variants=bv,
+                             leaves=list(_DUAL_CKPT_LEAVES),
+                             expect_extra=extra)
+        if restored is not None:
+            acc, start_variant, _stats = restored
+            start_pass = int(np.asarray(acc.pop("passno")))
+            repl = meshes.replicated(plan.mesh)
+            state = {k: jax.device_put(np.asarray(v), repl)
+                     for k, v in acc.items()}
+    if state is None:
+        state = sketch.init_dual_state(plan, n, rank, cfg.sketch_seed)
+
+    checkpointing = bool(cfg.checkpoint_dir and cfg.checkpoint_every_blocks)
+    n_variants = 0
+    by = None
+    for pass_idx in range(start_pass, passes):
+        cb = None
+        if checkpointing:
+            def cb(st, cur, _p=pass_idx):
+                save_state(st, cur, _p)
+        with_den = pass_idx == 0
+        with telemetry.span("solver.pass", cat="solver", index=pass_idx,
+                            rung=cfg.solver, dual=True):
+            state, n_variants = R.run_sketch_pass(
+                job, source, timer, plan, updates[with_den], state,
+                start_variant=start_variant if pass_idx == start_pass else 0,
+                packed=packed,
+                block_flops=lambda v, _wd=with_den: (
+                    sketch.dual_flops_per_block(n, v, rank, metric,
+                                                with_den=_wd)),
+                save_cb=cb,
+            )
+        telemetry.count("solver.passes")
+        if pass_idx == 0:
+            # The denominator has now been seen once: its exact
+            # streamed diagonal becomes the rank-1 scale, and the
+            # denominator sketch prices the rank-1 residual the scaled
+            # operator absorbs (solver.dual_den_defect — the honesty
+            # gauge for the 'controlled approximation' claim).
+            state = dict(state)
+            state["scale"], defect = sketch.dual_scale(state, plan)
+            telemetry.gauge_set("solver.dual_den_defect",
+                                float(np.asarray(defect)))
+        by = sketch.dual_apply(state)
+        if pass_idx + 1 < passes:
+            # Subspace iteration on B: orthonormalize the scaled,
+            # centered range and fold the scale into the next pass's
+            # streamed probes.
+            qn = solve.orthonormalize(by, plan)
+            state = sketch.reset_dual_pass(plan, state, qn)
+            if checkpointing:
+                save_state(state, 0, pass_idx + 1)
+
+    k = cfg.num_pc
+    with timer.phase("eigh"):
+        with telemetry.span("solver.solve", cat="solver", rung=cfg.solver,
+                            dual=True):
+            if cfg.solver == "sketch":
+                vals, vecs = solve.nystrom_eigs_scaled(
+                    state["y"], state["qc"], by, k, plan)
+            else:
+                vals, vecs = solve.rayleigh_eigs(by, state["q"], k, plan)
+            vals, vecs = hard_sync((vals, vecs))
+
+    vals_np = np.asarray(vals)
+    coords = np.asarray(coords_from_eigpairs(vals, vecs))
+    return SketchSolveResult(
+        sample_ids=source.sample_ids,
+        eigenvalues=vals_np,
+        coords=coords,
+        proportion=None,
         n_variants=n_variants,
         rung=cfg.solver,
         rank=int(rank),

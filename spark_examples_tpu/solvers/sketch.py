@@ -48,11 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_examples_tpu import kernels
 from spark_examples_tpu.core import meshes
-from spark_examples_tpu.core.config import (
-    SKETCH_METRICS,
-    unsketchable_metric_error,
-)
 from spark_examples_tpu.ops import gram as gram_ops
 from spark_examples_tpu.parallel.gram_sharded import GramPlan
 
@@ -60,13 +57,20 @@ from spark_examples_tpu.parallel.gram_sharded import GramPlan
 # any gram accumulator; the pass index rides in the manifest's extra).
 STATE_LEAVES = ("y", "qc", "trace", "nvar")
 
+# The dual-sketch (ratio-metric) state: numerator sketch ``y``,
+# denominator sketch ``yd``, the EXACT streamed denominator diagonal
+# ``d`` (per-sample pair-count mass — one rowsum per term per block),
+# the orthonormal test basis ``q``, the streamed probe block ``qc``
+# (= q / a per row after pass 0), and the rank-1 denominator factor
+# ``scale`` (= a = sqrt(d); ones until pass 0 ends).
+DUAL_STATE_LEAVES = ("y", "yd", "d", "q", "qc", "scale")
+
 
 def check_sketchable(metric: str, solver: str) -> None:
     """The one runtime gate (config-time validation cannot see a
-    ``metric=None`` driver default resolve to ibs). Same message text
-    as the config-time rejection — one builder, no drift."""
-    if metric not in SKETCH_METRICS:
-        raise ValueError(unsketchable_metric_error(metric, solver))
+    ``metric=None`` driver default resolve to ibs). Delegates to the
+    kernel registry's gate — one builder, no drift."""
+    kernels.check_sketchable(metric, solver)
 
 
 def probes(n: int, rank: int, seed: int) -> jnp.ndarray:
@@ -87,25 +91,15 @@ def center_cols(x: jnp.ndarray) -> jnp.ndarray:
 
 def _features(block, metric: str, grm_precise: bool):
     """(N, v) int8 dosages -> (A_b, kept): the streamed Gram factor's
-    columns for this block, plus the variant count feeding the grm
-    denominator. Padding columns (all MISSING) produce all-zero feature
-    columns — zero contribution to y, trace, and nvar alike."""
-    if metric == "shared-alt":
-        a = (block >= 1).astype(jnp.float32)
-        kept = jnp.float32(0.0)  # denominator unused
-    elif metric == "grm":
-        # Same standardization as the exact route; the sketch's matmuls
-        # then run f32 regardless of grm_precise (they are ~N/r cheaper
-        # than the dense update, so there is no rate to buy back).
-        a, keep = gram_ops.grm_standardize(block, grm_precise)
-        a = a.astype(jnp.float32)
-        kept = keep.sum().astype(jnp.float32)
-    elif metric in ("dot", "euclidean"):
-        a = jnp.where(block >= 0, block, 0).astype(jnp.float32)
-        kept = jnp.float32(0.0)
-    else:  # static arg — a typo dies at trace time, not as wrong math
-        raise ValueError(f"metric {metric!r} is not sketchable")
-    return a, kept
+    columns for this block (the kernel's declared FactorSketch
+    features), plus the variant count feeding the nvar denominator.
+    Padding columns (all MISSING) produce all-zero feature columns —
+    zero contribution to y, trace, and nvar alike."""
+    spec = kernels.get(metric).sketch
+    if not isinstance(spec, kernels.FactorSketch):
+        # static arg — a typo dies at trace time, not as wrong math
+        raise ValueError(f"metric {metric!r} has no factor sketch")
+    return spec.features(block, grm_precise)
 
 
 def _update_impl(state, block, metric: str, packed: bool,
@@ -213,6 +207,238 @@ def finalize_pass(y, trace, nvar, is_grm: bool = False):
     return center_cols(y) / denom, trace / denom
 
 
+# --------------------------------------------------------------------
+# Dual sketch: ratio metrics (similarity = NUM ⊘ DEN) stream numerator
+# AND pair-count denominator as two low-rank sketches in the same
+# variant pass (kernels/base.py DualSketch; arXiv:1911.04200's
+# communication-efficient direction recast onto the range-sketch
+# machinery). After pass 0 the denominator's dominant (Perron) rank-1
+# factor a a^T is extracted from ITS sketch, and every later pass (and
+# the terminal solve) targets the scaled operator
+#
+#     B = J diag(1/a) NUM diag(1/a) J  ~  J (NUM ⊘ DEN) J
+#
+# — EXACT when DEN is rank-1 (IBS pair counts with no missing calls),
+# a controlled approximation otherwise. The matvec of B is exactly
+# streamable (NUM is a sum of cross-Grams of per-block features), so
+# the corrected rung's subspace iteration runs true power steps.
+
+
+def _dual_update_impl(state, block, metric: str, packed: bool,
+                      with_den: bool):
+    """One block into the sketches: y += NUM_b @ qc and — on pass 0
+    only (``with_den``) — yd += DEN_b @ qc plus the exact denominator
+    diagonal. Passes >= 1 are pure power steps of the scaled operator:
+    the scale and defect are fixed once after pass 0, so re-streaming
+    the denominator there would be dead matmuls.
+
+    Each distinct right operand is contracted against the probes once
+    ((v, r), local under variant sharding); each term then adds one
+    (N, v) x (v, r) product — under a multi-device plan XLA inserts the
+    per-block psum there, the same collective as the factor sketch."""
+    if packed:
+        from spark_examples_tpu.ingest.bitpack import unpack_dosages
+
+        block = unpack_dosages(block)
+    spec = kernels.get(metric).sketch
+    ops = spec.operands(block)
+    qc = state["qc"]
+    terms = spec.num_terms + (spec.den_terms if with_den else ())
+    rights = {}
+    for (_l, r, _w) in terms:
+        if r not in rights:
+            rights[r] = jax.lax.dot_general(
+                ops[r], qc, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    def apply(terms, y):
+        for (l, r, w) in terms:
+            contrib = jax.lax.dot_general(
+                ops[l], rights[r], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            y = y + (contrib * w if w != 1.0 else contrib)
+        return y
+
+    # diag(DEN) streams EXACTLY: diag(L R^T) is one elementwise rowsum
+    # per term, O(Nv) next to the sketches' matmuls — the scale the
+    # solve divides by is never itself an estimate.
+    d = state["d"]
+    if with_den:
+        for (l, r, w) in spec.den_terms:
+            d = d + w * (ops[l] * ops[r]).sum(axis=1)
+
+    return {
+        "y": apply(spec.num_terms, state["y"]),
+        "yd": (apply(spec.den_terms, state["yd"]) if with_den
+               else state["yd"]),
+        "d": d,
+        "q": state["q"],
+        "qc": qc,
+        "scale": state["scale"],
+    }
+
+
+@lru_cache(maxsize=64)
+def _jitted_dual_update(plan: GramPlan, metric: str, packed: bool,
+                        with_den: bool):
+    repl = meshes.replicated(plan.mesh)
+    state_sh = {k: repl for k in DUAL_STATE_LEAVES}
+    return jax.jit(
+        partial(_dual_update_impl, metric=metric, packed=packed,
+                with_den=with_den),
+        in_shardings=(state_sh, plan.block_sharding),
+        out_shardings=state_sh,
+        donate_argnums=(0,),
+    )
+
+
+def make_dual_update(plan: GramPlan, metric: str, packed: bool = False,
+                     with_den: bool = True):
+    """Jitted dual-sketch ``(state, block) -> state`` — the ratio-metric
+    twin of :func:`make_update`, same block transport handling.
+    ``with_den=False`` builds the pass->=1 variant that streams only
+    the numerator (the denominator work is pass-0-only)."""
+    check_sketchable(metric, "corrected")
+    jitted = _jitted_dual_update(plan, metric, packed, with_den)
+    n_shards = plan.block_shards
+
+    def update(state, block):
+        if not (isinstance(block, jax.Array)
+                and block.sharding == plan.block_sharding):
+            block = np.asarray(block)
+            if block.shape[1] % n_shards:
+                from spark_examples_tpu.ingest.prefetch import (
+                    pad_block, pad_packed,
+                )
+
+                width = -(-block.shape[1] // n_shards) * n_shards
+                block = (pad_packed(block, width) if packed
+                         else pad_block(block, width))
+            block = jax.device_put(block, plan.block_sharding)
+        return jitted(state, block)
+
+    return update
+
+
+def init_dual_state(plan: GramPlan, n: int, rank: int, seed: int) -> dict:
+    """Fresh dual state: zero sketches, CENTERED probes as both the
+    test basis and the streamed input, unit scale (pass 0 streams the
+    UNSCALED operators — the scale does not exist until the
+    denominator's exact diagonal has been seen once).
+
+    Centered deliberately: both NUM and DEN carry an enormous
+    near-constant rank-1 component (the per-pair count mass, ~100x the
+    structure), which is exactly what the downstream double centering
+    annihilates — streaming against J q means the rank budget is spent
+    on the components B actually keeps, not on re-discovering the
+    Perron direction. This is only possible because the SCALE does not
+    come from the denominator sketch (diag(DEN) streams exactly in the
+    same pass); yd's remaining job — pricing the rank-1 residual — is
+    normalized against the exact trace mass, not against ||DEN J q||."""
+    repl = meshes.replicated(plan.mesh)
+    r = min(rank, n)
+    # q and qc start numerically equal but MUST be distinct buffers:
+    # the jitted update donates the whole state pytree, and aliased
+    # leaves would be donated twice (host round-trip for the copy).
+    qc = np.asarray(center_cols(probes(n, rank, seed)))
+    return {
+        "y": jax.device_put(jnp.zeros((n, r), jnp.float32), repl),
+        "yd": jax.device_put(jnp.zeros((n, r), jnp.float32), repl),
+        "d": jax.device_put(jnp.zeros((n,), jnp.float32), repl),
+        "q": jax.device_put(qc, repl),
+        "qc": jax.device_put(np.array(qc), repl),
+        "scale": jax.device_put(jnp.ones((n,), jnp.float32), repl),
+    }
+
+
+@jax.jit
+def _dual_scale_impl(d, yd, qc):
+    """Rank-1 factor ``a = sqrt(diag(DEN))`` from the EXACTLY streamed
+    denominator diagonal, plus the honesty number: how far DEN actually
+    is from ``a a^T``, measured against the denominator SKETCH
+    (``defect = ||yd - a (a^T qc)||_F / ||yd||_F`` — yd = DEN qc, so
+    this is a probe-space estimate of the rank-1 residual the scaled
+    operator absorbs).
+
+    sqrt(diag) — not the Perron eigenvector — deliberately: it needs no
+    eigen-estimation (DEN is INDEFINITE for union-count denominators,
+    so Nystrom would NaN), it is bit-deterministic, it equals the
+    Perron factor exactly whenever DEN IS rank-1 (the regime the dual
+    rungs are exact in), and it pins the scaled similarity's diagonal
+    at NUM_ii/DEN_ii = 1 — the self-similarity the downstream Gower
+    centering hinges on. Samples with an empty denominator are floored
+    at 1e-3 of the mean scale so they get a bounded, not infinite,
+    scaling."""
+    a = jnp.sqrt(jnp.maximum(d, 0.0))
+    a = jnp.maximum(a, 1e-3 * jnp.maximum(a.mean(), 1e-30))
+    resid = yd - a[:, None] * (a @ qc)[None, :]
+    # ||E J||_F estimate (gaussian probes: E||A q||_F^2 = r ||A||_F^2)
+    # over the EXACT trace mass sum(d) = tr(DEN) (= ||a a^T||_F when
+    # DEN is rank-1) — NOT over ||yd||: the centered probes annihilate
+    # most of DEN's rank-1 mass, so that ratio would read ~1 even for
+    # a nearly-exact denominator.
+    r = qc.shape[1]
+    defect = (jnp.linalg.norm(resid) / jnp.sqrt(1.0 * r)) / jnp.maximum(
+        d.sum(), 1e-30)
+    return a, defect
+
+
+def dual_scale(state: dict, plan: GramPlan):
+    """The denominator's rank-1 scale factor (and its measured rank-1
+    defect) from the completed pass-0 state — state leaves are
+    replicated under every plan, so this is collective-free."""
+    return _dual_scale_impl(state["d"], state["yd"], state["qc"])
+
+
+@jax.jit
+def _dual_apply_impl(y, scale):
+    return center_cols(y / scale[:, None])
+
+
+def dual_apply(state: dict):
+    """Completed-pass numerator sketch -> the scaled, centered factor
+    ``J diag(1/a) (NUM @ qc)`` — for passes >= 1 (qc = Dinv q) this IS
+    ``B @ q``; for pass 0 it is the starting block whose range the
+    corrected rung orthonormalizes."""
+    return _dual_apply_impl(state["y"], state["scale"])
+
+
+def reset_dual_pass(plan: GramPlan, state: dict, q_next) -> dict:
+    """Fresh sketches for the next streamed pass: track the orthonormal
+    basis ``q_next`` and stream against ``q_next / a`` so the pass
+    computes NUM @ (diag(1/a) q) — the inner half of B's matvec."""
+    repl = meshes.replicated(plan.mesh)
+    return {
+        "y": jax.device_put(jnp.zeros_like(state["y"]), repl),
+        "yd": jax.device_put(jnp.zeros_like(state["yd"]), repl),
+        "d": jax.device_put(jnp.zeros_like(state["d"]), repl),
+        "q": jax.device_put(q_next, repl),
+        "qc": jax.device_put(q_next / state["scale"][:, None], repl),
+        "scale": state["scale"],
+    }
+
+
+def dual_state_bytes(n: int, rank: int) -> int:
+    """Peak dual-solver state residency: four (N, r) f32 leaves plus
+    the (N,) diagonal and scale vectors."""
+    r = min(rank, n)
+    return (4 * n * r + 2 * n) * 4
+
+
+def dual_flops_per_block(n: int, v: int, rank: int, metric: str,
+                         with_den: bool = True) -> float:
+    """Skinny-matmul work of one dual-sketch block update: one (v, r)
+    probe contraction per distinct right operand plus one (N, v) x
+    (v, r) product per streamed term — num+den on pass 0, num only on
+    the later passes (honest credit for the work actually run)."""
+    spec = kernels.get(metric).sketch
+    terms = spec.num_terms + (spec.den_terms if with_den else ())
+    n_rights = len({r for (_l, r, _w) in terms})
+    return 2.0 * n * v * min(rank, n) * (n_rights + len(terms))
+
+
 def state_bytes(n: int, rank: int) -> int:
     """Peak solver-state residency: y + qc f32 leaves (the scalars are
     noise). THE 'peak solver memory' number bench reports — compare
@@ -223,8 +449,12 @@ def state_bytes(n: int, rank: int) -> int:
 
 def nxn_bytes(n: int, metric: str) -> int:
     """What the dense route's accumulators would have allocated for this
-    cohort/metric — the allocation the sketch path exists to avoid."""
-    n_acc = max(len(gram_ops.PIECES_FOR_METRIC.get(metric, ("zz",))), 1)
+    cohort/metric — the allocation the sketch path exists to avoid.
+    Live-registry count of the N x N leaves (scalar leaves like grm's
+    nvar are noise and excluded)."""
+    kern = kernels.maybe_get(metric)
+    n_acc = (max(len(kern.acc_leaves) - len(kern.scalar_leaves), 1)
+             if kern is not None else 1)
     return 4 * n * n * n_acc
 
 

@@ -105,6 +105,34 @@ def _nystrom_impl(y, qc, k: int, plan):
     return jnp.maximum(vals - shift, 0.0), vecs
 
 
+def _nystrom_scaled_impl(y, qc, g, k: int, plan):
+    """Nystrom eigenpairs of a *congruence-transformed* operator: the
+    core is still ``qc^T y = qc^T NUM qc`` (PSD when NUM is), but the
+    outer factor is ``g = M y`` for some row transform M (the dual
+    sketch's ``J diag(1/a)``), giving ``B = M NUM M^T ~ g C^+ g^T`` —
+    the single-pass rung of the dual-sketch ladder."""
+    y = _pin_rows(plan, y)
+    qc = _pin_rows(plan, qc)
+    g = _pin_rows(plan, g)
+    core = jax.lax.dot_general(  # qc^T NUM qc: local + psum
+        qc, y, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    l, shift = _shifted_chol(core)
+    w = jax.scipy.linalg.solve_triangular(
+        l, jnp.eye(l.shape[0], dtype=l.dtype), lower=True
+    )
+    f = _pin_rows(plan, g @ w.T)
+    gm = jax.lax.dot_general(
+        f, f, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    e, s = jnp.linalg.eigh(0.5 * (gm + gm.T))  # ascending
+    vals = e[::-1][:k]
+    vecs = f @ (s[:, ::-1][:, :k] / jnp.sqrt(jnp.maximum(e[::-1][:k], 1e-30)))
+    return jnp.maximum(vals - shift, 0.0), vecs
+
+
 def _rayleigh_impl(y, q, k: int, plan):
     y = _pin_rows(plan, y)
     q = _pin_rows(plan, q)
@@ -137,6 +165,16 @@ def _nystrom_jit(plan: GramPlan | None, k: int):
 
 
 @lru_cache(maxsize=32)
+def _nystrom_scaled_jit(plan: GramPlan | None, k: int):
+    repl = None if plan is None else meshes.replicated(plan.mesh)
+    kw = {} if repl is None else {
+        "in_shardings": (repl, repl, repl), "out_shardings": (repl, repl),
+    }
+    return jax.jit(lambda y, qc, g: _nystrom_scaled_impl(y, qc, g, k, plan),
+                   **kw)
+
+
+@lru_cache(maxsize=32)
 def _rayleigh_jit(plan: GramPlan | None, k: int):
     repl = None if plan is None else meshes.replicated(plan.mesh)
     kw = {} if repl is None else {
@@ -159,6 +197,15 @@ def nystrom_eigs(y: jnp.ndarray, qc: jnp.ndarray, k: int,
     from sketch ``y = B @ omega`` and test block ``qc``. Returns
     (vals (k,) descending >= 0, vecs (N, k) orthonormal)."""
     return _nystrom_jit(plan, k)(y, qc)
+
+
+def nystrom_eigs_scaled(y: jnp.ndarray, qc: jnp.ndarray, g: jnp.ndarray,
+                        k: int, plan: GramPlan | None = None):
+    """Top-k eigenpairs of ``B = M NUM M^T`` from the NUM sketch
+    ``y = NUM @ qc`` and its row-transformed twin ``g = M y`` (the dual
+    sketch's scaled/centered factor). NUM must be PSD (the core is its
+    Nystrom core) — the registry's ``num_psd`` gate."""
+    return _nystrom_scaled_jit(plan, k)(y, qc, g)
 
 
 def rayleigh_eigs(y: jnp.ndarray, q: jnp.ndarray, k: int,

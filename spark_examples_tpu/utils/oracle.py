@@ -70,6 +70,26 @@ def naive_king(genotypes: np.ndarray) -> np.ndarray:
     return phi
 
 
+def naive_jaccard(genotypes: np.ndarray) -> np.ndarray:
+    """Carrier-set Jaccard similarity by explicit per-pair set algebra —
+    deliberately NOT derived from the matmul combine, so it
+    independently pins the kernel's reformulation: over pairwise-
+    complete variants, J = |carriers(i) ∩ carriers(j)| / |∪|, with the
+    empty-union pair -> 1 (indistinguishable from identical, the same
+    convention spirit as ibs's zero-overlap -> distance 0)."""
+    g = genotypes.astype(np.int64)
+    n = g.shape[0]
+    sim = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            valid = (g[i] >= 0) & (g[j] >= 0)
+            a = g[i][valid] >= 1
+            b = g[j][valid] >= 1
+            union = int((a | b).sum())
+            sim[i, j] = (a & b).sum() / union if union > 0 else 1.0
+    return sim
+
+
 def naive_braycurtis(x: np.ndarray) -> np.ndarray:
     n = x.shape[0]
     d = np.zeros((n, n))
@@ -83,7 +103,7 @@ def naive_braycurtis(x: np.ndarray) -> np.ndarray:
 
 def naive_grm(genotypes: np.ndarray) -> np.ndarray:
     """VanRaden GRM with in-matrix allele frequencies, mean-imputed
-    missing — matches ops.gram.update_grm run as one block."""
+    missing — matches the grm kernel's update run as one block."""
     g = genotypes.astype(np.float64)
     valid = g >= 0
     y = np.where(valid, g, 0.0)
@@ -184,33 +204,15 @@ def cpu_ibs_distance(genotypes: np.ndarray) -> np.ndarray:
 
 def cpu_finalize(acc: dict, metric: str) -> dict:
     """NumPy mirror of ops.distances.finalize for the cpu-reference
-    backend (same pinned conventions)."""
+    backend — dispatches to the kernel's declared ``np_finalize``
+    (spark_examples_tpu/kernels), the registration-adjacent twin of the
+    jax finalize, so the two conventions can never drift apart."""
+    from spark_examples_tpu import kernels
 
-    def gower(s):
-        diag = np.diagonal(s)
-        return np.sqrt(np.maximum(diag[:, None] + diag[None, :] - 2 * s, 0.0))
-
-    if metric == "ibs":
-        dist = np.where(acc["m"] > 0, acc["d1"] / (2.0 * acc["m"]), 0.0)
-        return {"similarity": 1.0 - dist, "distance": dist}
-    if metric == "ibs2":
-        sim = np.where(acc["m"] > 0, acc["ibs2"] / acc["m"], 1.0)
-        return {"similarity": sim, "distance": 1.0 - sim}
-    if metric == "shared-alt":
-        return {"similarity": acc["s"], "distance": gower(acc["s"])}
-    if metric == "euclidean":
-        d = np.sqrt(np.maximum(acc["e2"], 0.0))
-        return {"similarity": -d, "distance": d}
-    if metric == "dot":
-        return {"similarity": acc["dot"], "distance": gower(acc["dot"])}
-    if metric == "king":
-        den = acc["hc"] + acc["hc"].T
-        with np.errstate(invalid="ignore", divide="ignore"):
-            phi = np.where(den > 0, (acc["hh"] - 2 * acc["opp"]) / den, 0.0)
-        np.fill_diagonal(phi, 0.5)  # self-kinship even with zero hets
-        return {"similarity": phi,
-                "distance": np.maximum(0.5 - phi, 0.0)}
-    raise ValueError(f"unknown metric {metric!r}")
+    kern = kernels.maybe_get(metric)
+    if kern is None or kern.np_finalize is None:
+        raise ValueError(f"unknown metric {metric!r}")
+    return kern.np_finalize(acc)
 
 
 def cpu_braycurtis(x: np.ndarray) -> np.ndarray:

@@ -1,0 +1,492 @@
+"""Similarity-kernel registry (spark_examples_tpu/kernels): contract
+lints, registry-route bit-identity for every pre-existing metric, the
+jaccard workload (golden values, conventions, packed/dense and
+multi-device parity, end-to-end eigensolve + serve), and the
+dual-sketch ladder for ratio metrics.
+
+The registry lints mirror the fault-site and telemetry-glossary lints:
+every registered kernel must declare a FLOPs model, carry a README
+"Similarity kernels" table row, and appear in at least one end-to-end
+test — a kernel that is registered but undocumented or untested is a
+lint failure, not a style nit.
+"""
+
+import pathlib
+import re
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu import kernels
+from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core.config import (
+    ComputeConfig,
+    DUAL_SKETCH_METRICS,
+    IngestConfig,
+    JobConfig,
+    SKETCH_METRICS,
+)
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.ops import distances, gram
+from spark_examples_tpu.pipelines import runner
+from spark_examples_tpu.pipelines.jobs import pcoa_job
+from spark_examples_tpu.utils import oracle
+from tests.conftest import random_genotypes
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+GRAM_METRICS = kernels.gram_names()
+ALL_METRICS = kernels.names()
+
+
+# ------------------------------------------------------------ registry
+
+
+def test_builtin_registrations_complete():
+    """The seven pre-existing metrics, jaccard, and the braycurtis
+    table kernel are all registered; capability groups are derived, not
+    hand-listed."""
+    assert set(ALL_METRICS) == {
+        "ibs", "ibs2", "shared-alt", "euclidean", "dot", "king",
+        "jaccard", "grm", "braycurtis",
+    }
+    assert set(GRAM_METRICS) == set(ALL_METRICS) - {"braycurtis"}
+    assert set(SKETCH_METRICS) == {"shared-alt", "grm", "dot", "euclidean"}
+    assert set(DUAL_SKETCH_METRICS) == {"ibs", "jaccard"}
+    assert set(kernels.unsketchable_names()) == {"ibs2", "king"}
+    # Consumers' tables are registry-derived.
+    assert set(gram.GRAM_METRICS) == set(GRAM_METRICS)
+    assert set(gram.DOSAGE_METRICS) == {
+        k.name for k in kernels.all_kernels() if k.is_gram and k.pack_auto
+    }
+    assert gram.MAX_INCREMENT == {
+        k.name: k.max_increment for k in kernels.all_kernels()
+        if k.max_increment is not None
+    }
+
+
+def test_register_rejects_half_declared_kernels():
+    """A half-declared kernel dies at registration, not as a KeyError
+    deep inside a streaming job."""
+    base = dict(name="_test_tmp", summary="x", family="count",
+                pieces=("t1t1",), stats=("s",), finalize=lambda s: s,
+                np_finalize=lambda s: s, max_increment=1,
+                flops=lambda n, v: 2.0 * n * n * v)
+    try:
+        with pytest.raises(ValueError, match="already registered"):
+            kernels.register(kernels.Kernel(**{**base, "name": "ibs"}))
+        with pytest.raises(ValueError, match="family"):
+            kernels.register(kernels.Kernel(**{**base, "family": "nope"}))
+        with pytest.raises(ValueError, match="FLOPs"):
+            kernels.register(kernels.Kernel(**{**base, "flops": None}))
+        with pytest.raises(ValueError, match="missing"):
+            kernels.register(kernels.Kernel(**{**base, "finalize": None}))
+        with pytest.raises(ValueError, match="missing"):
+            kernels.register(kernels.Kernel(**{**base,
+                                               "max_increment": None}))
+        with pytest.raises(ValueError, match="table_runner"):
+            kernels.register(kernels.Kernel(
+                name="_test_tmp", summary="x", family="table",
+                flops=lambda n, v: 1.0))
+        ops = lambda b: {}  # noqa: E731
+        ops.operand_names = ("a",)
+        with pytest.raises(ValueError, match="never declares"):
+            kernels.register(kernels.Kernel(
+                **{**base, "sketch": kernels.DualSketch(
+                    operands=ops, num_terms=(("a", "b", 1.0),),
+                    den_terms=(("a", "a", 1.0),))}))
+    finally:
+        kernels.unregister("_test_tmp")
+
+
+def test_late_registered_kernel_routes_through_gram(genotypes):
+    """A kernel registered AFTER ops/gram imported still routes through
+    init/update/combine/finalize — dispatch reads the live registry,
+    not the import-time snapshot dicts (which exist for introspection
+    only). This is the 'adding a kernel is one registration' contract
+    actually held to."""
+    import jax.numpy as jnp
+
+    def _fin(stats):
+        s = stats["s"].astype(jnp.float32)
+        return {"similarity": s,
+                "distance": distances.similarity_to_distance(s)}
+
+    def _np_fin(acc):
+        d = oracle.cpu_finalize({"s": acc["s"]}, "shared-alt")
+        return d
+
+    kernels.register(kernels.Kernel(
+        name="_late_test", summary="late registration smoke",
+        family="count", pieces=("t1t1",), stats=("s",),
+        finalize=_fin, np_finalize=_np_fin, pack_auto=True,
+        max_increment=1, flops=lambda n, v: 2.0 * n * n * v,
+    ))
+    try:
+        acc = gram.init(genotypes.shape[0], "_late_test")
+        acc = gram.update(acc, genotypes, "_late_test")
+        out = distances.finalize(acc, "_late_test")
+        want = distances.finalize(
+            gram.update(gram.init(genotypes.shape[0], "shared-alt"),
+                        genotypes, "shared-alt"), "shared-alt")
+        np.testing.assert_array_equal(np.asarray(out["similarity"]),
+                                      np.asarray(want["similarity"]))
+    finally:
+        kernels.unregister("_late_test")
+
+
+def test_unknown_metric_error_names_registered_kernels():
+    """Config-time rejection lists the registry, never a stale string."""
+    with pytest.raises(ValueError) as e:
+        ComputeConfig(metric="cosine")
+    for name in ("jaccard", "ibs", "braycurtis"):
+        assert name in str(e.value)
+
+
+def test_unsketchable_error_names_every_streamability_group():
+    msg = kernels.unsketchable_metric_error("king", "sketch")
+    for name in ("shared-alt", "grm", "ibs", "jaccard", "ibs2", "king"):
+        assert name in msg
+    assert "dual sketch" in msg
+    assert "--solver exact" in msg
+
+
+def test_every_kernel_declares_a_positive_flops_model():
+    for kern in kernels.all_kernels():
+        assert kern.flops is not None, kern.name
+        assert kern.flops(64, 128) > 0, kern.name
+
+
+def test_every_kernel_has_a_readme_row():
+    """The README 'Similarity kernels' table documents every registered
+    kernel (and no ghost kernels) — the docs half of the registry
+    contract."""
+    text = (REPO / "README.md").read_text()
+    rows = set(re.findall(r"^\| `([\w-]+)`", text, re.MULTILINE))
+    missing = set(ALL_METRICS) - rows
+    assert not missing, (
+        f"kernels registered but missing a README table row: {missing}")
+
+
+def test_every_kernel_is_a_cli_choice(tmp_path, capsys):
+    """The CLI's --metric choices come from the registry — a registered
+    kernel must be reachable from the command line without a cli/main.py
+    edit (the gap the first jaccard CLI drive actually hit)."""
+    from spark_examples_tpu.cli.main import main
+
+    with pytest.raises(SystemExit):
+        main(["similarity", "--metric", "not-a-kernel"])
+    capsys.readouterr()
+    out = str(tmp_path / "sim.tsv")
+    rc = main(["similarity", "--metric", "jaccard", "--n-samples", "12",
+               "--n-variants", "512", "--block-variants", "256",
+               "--output-path", out])
+    assert rc == 0
+    assert "similarity[jaccard]" in capsys.readouterr().out
+    # Every registered name parses as a valid choice (--help exits 0
+    # after choice validation; an unknown choice exits 2).
+    for name in ALL_METRICS:
+        with pytest.raises(SystemExit) as e:
+            main(["similarity", "--metric", name, "--help"])
+        assert e.value.code == 0, f"{name} rejected by the CLI parser"
+    capsys.readouterr()
+
+
+def test_every_kernel_appears_in_an_end_to_end_test():
+    """Every registered kernel name is exercised by at least one test
+    that names it as a metric — a registered-but-untested kernel is a
+    lint failure."""
+    corpus = "\n".join(
+        p.read_text() for p in (REPO / "tests").glob("test_*.py"))
+    untested = [
+        name for name in ALL_METRICS
+        if f'"{name}"' not in corpus and f"'{name}'" not in corpus
+    ]
+    assert not untested, f"kernels never exercised by tests: {untested}"
+
+
+# ------------------------------------------ registry-route bit-identity
+
+
+def _dense_acc(g, metric):
+    """Stream g through the registry's dense gram route (unsharded)."""
+    acc = gram.init(g.shape[0], metric)
+    for s in range(0, g.shape[1], 64):
+        acc = gram.update(acc, g[:, s:s + 64], metric)
+    return acc
+
+
+@pytest.mark.parametrize("metric", GRAM_METRICS)
+def test_jax_and_numpy_finalize_twins_agree(genotypes, metric):
+    """Each kernel's jax finalize and its registration-adjacent NumPy
+    oracle mirror produce the same similarity/distance from the same
+    accumulated statistics — the two conventions can never drift."""
+    acc = _dense_acc(genotypes, metric)
+    got = {k: np.asarray(v)
+           for k, v in distances.finalize(acc, metric).items()}
+    stats = {k: np.asarray(v) for k, v in gram.combine(acc, metric).items()}
+    want = oracle.cpu_finalize(stats, metric)
+    np.testing.assert_allclose(got["similarity"], want["similarity"],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got["distance"], want["distance"],
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("metric",
+                         [m for m in GRAM_METRICS
+                          if kernels.get(m).pack_auto])
+def test_packed_vs_dense_bit_identity(rng, metric):
+    """--pack-stream packed and dense produce BIT-identical results
+    through the registry route for every 2-bit-packable kernel."""
+    g = random_genotypes(rng, n=24, v=384, missing_rate=0.15)
+    out = {}
+    for pack in ("dense", "packed"):
+        out[pack] = runner.run_similarity(
+            JobConfig(
+                ingest=IngestConfig(block_variants=128),
+                compute=ComputeConfig(metric=metric, pack_stream=pack),
+            ),
+            source=ArraySource(g),
+        )
+    np.testing.assert_array_equal(out["dense"].similarity,
+                                  out["packed"].similarity)
+    np.testing.assert_array_equal(out["dense"].distance,
+                                  out["packed"].distance)
+
+
+@pytest.mark.parametrize("metric", ["ibs", "ibs2", "king", "jaccard"])
+def test_tile2d_multi_device_matches_replicated(rng, metric):
+    """Counting kernels are integer-exact, so the tile2d plan over the
+    8 virtual devices must match the replicated single-accumulator plan
+    BIT-identically — the registry's sharding declarations ride the
+    same machinery for old and new kernels alike."""
+    g = random_genotypes(rng, n=48, v=512, missing_rate=0.1)
+    out = {}
+    for mode in ("replicated", "tile2d"):
+        out[mode] = runner.run_similarity(
+            JobConfig(
+                ingest=IngestConfig(block_variants=128),
+                compute=ComputeConfig(metric=metric, gram_mode=mode),
+            ),
+            source=ArraySource(g),
+        )
+    np.testing.assert_array_equal(out["replicated"].similarity,
+                                  out["tile2d"].similarity)
+
+
+def test_grm_tile2d_matches_replicated(rng):
+    """The float-family kernel's declared tile body under the tile2d
+    plan agrees with the replicated route (f32 accumulation: same
+    per-block order, so identical up to layout — pinned allclose)."""
+    g = random_genotypes(rng, n=48, v=512, missing_rate=0.1)
+    out = {}
+    for mode in ("replicated", "tile2d"):
+        out[mode] = runner.run_similarity(
+            JobConfig(
+                ingest=IngestConfig(block_variants=128),
+                compute=ComputeConfig(metric="grm", gram_mode=mode),
+            ),
+            source=ArraySource(g),
+        )
+    np.testing.assert_allclose(out["replicated"].similarity,
+                               out["tile2d"].similarity,
+                               rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------- jaccard
+
+
+def test_jaccard_matches_naive_oracle(genotypes):
+    """Golden values: the registry's matmul reformulation of carrier-set
+    Jaccard equals the deliberately-independent per-pair set-algebra
+    oracle; symmetry, exact unit diagonal, [0, 1] range, and the Gower
+    distance relation all hold."""
+    out = distances.finalize(_dense_acc(genotypes, "jaccard"), "jaccard")
+    sim = np.asarray(out["similarity"])
+    want = oracle.naive_jaccard(genotypes)
+    np.testing.assert_allclose(sim, want, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(sim, sim.T, atol=1e-7)
+    np.testing.assert_allclose(np.diag(sim), 1.0, atol=1e-7)
+    assert (sim >= 0).all() and (sim <= 1 + 1e-7).all()
+    d = np.asarray(out["distance"])
+    np.testing.assert_allclose(d * d, np.maximum(2.0 - 2.0 * sim, 0.0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_jaccard_empty_union_convention():
+    """Pairs with an empty carrier union cannot be distinguished from
+    identical -> similarity 1 (the ibs zero-overlap convention's
+    spirit), including the all-hom-ref sample's diagonal."""
+    g = np.zeros((3, 50), np.int8)
+    g[2, ::2] = 1  # one real carrier
+    sim = np.asarray(
+        distances.finalize(_dense_acc(g, "jaccard"), "jaccard")["similarity"]
+    )
+    assert sim[0, 1] == 1.0 and sim[1, 0] == 1.0
+    assert sim[0, 0] == 1.0
+    assert sim[0, 2] == 0.0  # empty vs carrier: empty intersection
+
+
+def test_jaccard_duplicate_detection():
+    """The scenario surface the kernel ships for: an exact duplicate
+    pair pins similarity 1 even through missingness; unrelated random
+    carriers sit well below."""
+    rng = np.random.default_rng(7)
+    g = random_genotypes(rng, n=10, v=600, missing_rate=0.05)
+    g[5] = g[0]  # plant a duplicate
+    sim = np.asarray(
+        distances.finalize(_dense_acc(g, "jaccard"), "jaccard")["similarity"]
+    )
+    assert sim[0, 5] == 1.0
+    others = sim[0, [j for j in range(1, 10) if j != 5]]
+    assert others.max() < 0.95
+
+
+def test_jaccard_end_to_end_eigensolve_serve(rng, tmp_path):
+    """Acceptance: --metric jaccard runs end-to-end — exact eigensolve
+    with a saved model, offline projection of the training panel
+    reproducing the fitted coordinates, and the serving engine
+    bit-identical to the offline path."""
+    from spark_examples_tpu.pipelines.project import pcoa_project_job
+    from spark_examples_tpu.serve import ProjectionEngine
+
+    g_ref = random_genotypes(rng, n=16, v=256, missing_rate=0.1)
+    model = str(tmp_path / "jaccard.npz")
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=64),
+        compute=ComputeConfig(metric="jaccard", num_pc=4),
+        model_path=model,
+    )
+    fit = pcoa_job(job, source=ArraySource(g_ref))
+    # Offline projection of the panel's own rows reproduces the fitted
+    # coordinates (jaccard's distance IS the Gower transform and its
+    # self-similarity is exactly 1, so the extension is consistent).
+    proj = pcoa_project_job(
+        job.replace(model_path=None), model_path=model,
+        source_new=ArraySource(g_ref), source_ref=ArraySource(g_ref),
+    )
+    np.testing.assert_allclose(proj.coords, fit.coords,
+                               rtol=1e-3, atol=1e-4)
+    # Serving: bit-identical to the offline projection path.
+    engine = ProjectionEngine(model, ArraySource(g_ref),
+                              block_variants=64, max_batch=4)
+    query = random_genotypes(rng, n=3, v=256, missing_rate=0.1)
+    served = engine.project_batch(query)
+    for i in range(query.shape[0]):
+        offline = pcoa_project_job(
+            job.replace(model_path=None), model_path=model,
+            source_new=ArraySource(query[i:i + 1]),
+            source_ref=ArraySource(g_ref),
+        ).coords
+        np.testing.assert_array_equal(served[i:i + 1], offline)
+
+
+# ---------------------------------------------------- dual-sketch rungs
+
+
+def _dense_dual_target(g, metric, block=256):
+    """The dual rungs' declared target operator, built densely in
+    NumPy from the kernel's own spec: B = J diag(1/a) NUM diag(1/a) J
+    with a = sqrt(diag(DEN)) — solver error is measured against THIS
+    (the denominator's rank-1 defect vs the exact route is reported
+    separately by solver.dual_den_defect)."""
+    import jax.numpy as jnp
+
+    spec = kernels.get(metric).sketch
+    n = g.shape[0]
+    num = np.zeros((n, n))
+    den_diag = np.zeros(n)
+    for s in range(0, g.shape[1], block):
+        ops = {k: np.asarray(v, np.float64) for k, v in
+               spec.operands(jnp.asarray(g[:, s:s + block])).items()}
+        for (left, right, w) in spec.num_terms:
+            num += w * ops[left] @ ops[right].T
+        for (left, right, w) in spec.den_terms:
+            den_diag += w * (ops[left] * ops[right]).sum(axis=1)
+    a = np.sqrt(np.maximum(den_diag, 1e-30))
+    st = num / np.outer(a, a)
+    j = np.eye(n) - 1.0 / n
+    return np.linalg.eigvalsh(j @ st @ j)[::-1]
+
+
+@pytest.mark.parametrize("metric", ["ibs", "jaccard"])
+def test_dual_sketch_corrected_within_ladder_bound(metric):
+    """Acceptance: ratio metrics complete --solver corrected through
+    the dual sketch with solver relerr inside the PR-7 ladder bound
+    (structure < 1e-2 after 2 extra passes), and the dual telemetry
+    gauges record the construction."""
+    n, v, k = 96, 4096, 6
+    job = JobConfig(
+        ingest=IngestConfig(source="synthetic", n_samples=n, n_variants=v,
+                            block_variants=512, seed=3),
+        compute=ComputeConfig(metric=metric, num_pc=k, solver="corrected",
+                              sketch_rank=40, sketch_iters=2),
+    )
+    src = runner.build_source(job.ingest)
+    g = np.concatenate([b for b, _ in src.blocks(512)], axis=1)
+    want = _dense_dual_target(g, metric)[:k]
+    telemetry.reset()
+    got = pcoa_job(job)
+    ev = np.asarray(got.eigenvalues)
+    rel = np.abs(ev[:4] - want[:4]) / np.maximum(np.abs(want[:4]), 1e-12)
+    assert rel.max() < 1e-2, rel
+    gauges = telemetry.metrics_snapshot()["gauges"]
+    assert gauges["solver.dual"]["last"] == 1.0
+    defect = gauges["solver.dual_den_defect"]["last"]
+    assert 0.0 <= defect < 0.5
+    if metric == "ibs":
+        # ibs pair counts are near rank-1 (missingness only).
+        assert defect < 0.05
+    assert got.coords.shape == (n, k)
+
+
+def test_dual_sketch_rung_runs_and_orders_structure():
+    """The single-pass rung is available for PSD dual numerators
+    (num_psd) — coarser than corrected by design, but it completes and
+    keeps the structure/bulk split of its target operator."""
+    job = JobConfig(
+        ingest=IngestConfig(source="synthetic", n_samples=96,
+                            n_variants=4096, block_variants=512, seed=3),
+        compute=ComputeConfig(metric="jaccard", num_pc=6, solver="sketch",
+                              sketch_rank=40),
+    )
+    telemetry.reset()
+    out = pcoa_job(job)
+    ev = np.asarray(out.eigenvalues)
+    assert np.isfinite(ev).all() and (ev >= 0).all()
+    assert ev[0] > 1.2 * ev[4]  # 4 planted dims separate from bulk
+    assert telemetry.metrics_snapshot()["gauges"]["solver.rung"]["last"] == 0.0
+
+
+def test_dual_sketch_seeded_determinism():
+    def run(seed):
+        return pcoa_job(JobConfig(
+            ingest=IngestConfig(source="synthetic", n_samples=64,
+                                n_variants=1024, block_variants=256, seed=5),
+            compute=ComputeConfig(metric="jaccard", num_pc=4,
+                                  solver="corrected", sketch_rank=24,
+                                  sketch_iters=1, sketch_seed=seed),
+        ))
+    a, b, c = run(11), run(11), run(12)
+    np.testing.assert_array_equal(a.coords, b.coords)
+    assert not np.array_equal(a.coords, c.coords)
+
+
+def test_dual_sketch_checkpointed_run_bit_identical(tmp_path):
+    """The dual state rides the ordinary checkpoint machinery: a run
+    that checkpoints every block (and re-runs resuming from its own
+    final mid-pass state) matches the uncheckpointed run exactly."""
+    def run(ckpt_dir):
+        return pcoa_job(JobConfig(
+            ingest=IngestConfig(source="synthetic", n_samples=64,
+                                n_variants=1024, block_variants=256, seed=5),
+            compute=ComputeConfig(metric="ibs", num_pc=4,
+                                  solver="corrected", sketch_rank=24,
+                                  sketch_iters=1,
+                                  checkpoint_dir=ckpt_dir,
+                                  checkpoint_every_blocks=1 if ckpt_dir
+                                  else 0),
+        ))
+    plain = run(None)
+    ck = run(str(tmp_path / "dual_ck"))
+    np.testing.assert_array_equal(plain.coords, ck.coords)

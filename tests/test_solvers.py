@@ -153,17 +153,31 @@ def test_knob_validation_names_the_flags():
                       sketch_rank=16)
     with pytest.raises(ValueError, match="--sketch-iters"):
         ComputeConfig(solver="corrected", metric="grm", sketch_iters=0)
-    with pytest.raises(ValueError, match="--metric ibs"):
-        ComputeConfig(solver="sketch", metric="ibs")
+    # king declares no sketch form (indefinite numerator, far-from-
+    # rank-1 denominator) — rejected with the registry-derived text.
+    with pytest.raises(ValueError, match="--metric king"):
+        ComputeConfig(solver="sketch", metric="king")
+    with pytest.raises(ValueError, match="--metric ibs2"):
+        ComputeConfig(solver="sketch", metric="ibs2")
+    # Ratio metrics declaring a dual sketch are sketchable now.
+    ComputeConfig(solver="sketch", metric="ibs")
+    ComputeConfig(solver="corrected", metric="jaccard")
     # The exact rung constrains nothing new.
     ComputeConfig(solver="exact", metric="ibs")
 
 
 def test_unsketchable_metric_rejected_at_job_level():
-    """metric=None resolves to the pcoa driver default (ibs) only at job
-    time — the runtime gate must still reject it with the fix named."""
-    with pytest.raises(ValueError, match="ibs"):
-        pcoa_job(_job(None, "sketch"))
+    """The runtime gate (shared with config-time validation — one
+    registry-derived builder, no drift) still rejects kernels declaring
+    no sketch form, naming every streamability group."""
+    from spark_examples_tpu.solvers import sketch as sk
+
+    with pytest.raises(ValueError, match="king.*--solver exact"):
+        sk.check_sketchable("king", "sketch")
+    with pytest.raises(ValueError, match="dual sketch"):
+        sk.check_sketchable("ibs2", "corrected")
+    with pytest.raises(ValueError, match="king"):
+        pcoa_job(_job("king", "sketch"))
 
 
 def test_sketch_guards():

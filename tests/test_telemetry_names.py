@@ -263,6 +263,10 @@ def test_core_names_present():
         "solver.rank",
         "solver.state_bytes",
         "solver.nxn_bytes_avoided",
+        # similarity-kernel registry: the dual-sketch (ratio metric)
+        # solve path
+        "solver.dual",
+        "solver.dual_den_defect",
         # live telemetry plane + trend tracking (this PR's
         # instrumentation contract)
         "live.flush",

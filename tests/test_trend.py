@@ -43,6 +43,14 @@ def test_metric_directions_resolve_sensibly():
     assert d("store_ok") == trend.BOOL_MUST_HOLD
     assert d("tunnel_mb_s") is None  # environment, never gated
     assert d("metric") is None  # free-form string name
+    # Kernel-sweep metrics (the similarity-kernel registry PR):
+    # per-kernel throughputs go up, the sweep completeness gate holds.
+    assert d("kernel_jaccard_mb_s") == trend.HIGHER_IS_BETTER
+    assert d("kernel_jaccard_gflops") == trend.HIGHER_IS_BETTER
+    assert d("kernel_king_mb_s") == trend.HIGHER_IS_BETTER
+    assert d("kernel_king_gflops") == trend.HIGHER_IS_BETTER
+    assert d("kernel_sweep_min_gflops") == trend.HIGHER_IS_BETTER
+    assert d("kernel_sweep_ok") == trend.BOOL_MUST_HOLD
 
 
 # ------------------------------------------------------------------ the band

@@ -85,7 +85,10 @@ _EXPLICIT: dict[str, int | None] = {
 # with "_s" too), relerr before "_vs_" ("relerr_vs_exact" is an error,
 # not a speedup ratio), stall/compression rules before the generic
 # suffixes (a feed-stall FRACTION must go down, a compression RATIO
-# up — store PR contract).
+# up — store PR contract). The kernel-sweep metrics
+# (kernel_<name>_mb_s / kernel_<name>_gflops / kernel_sweep_min_gflops
+# from bench --kernels) ride the _mb_s and flops throughput rules,
+# kernel_sweep_ok the *_ok gate — pinned by tests/test_trend.py.
 _RULES: tuple[tuple[str, str, int], ...] = (
     ("contains", "relerr", LOWER_IS_BETTER),
     ("contains", "stall_frac", LOWER_IS_BETTER),
