@@ -31,6 +31,17 @@ by each kernel's own registered FLOPs model (headline
 ``kernel_jaccard_*`` / ``kernel_king_*`` / ``kernel_sweep_min_gflops``
 / ``kernel_sweep_ok``).
 
+``--multichip`` measures the REAL sharded tile2d path (not a dryrun) on
+whatever mesh exists — all local chips, or an 8-virtual-device CPU mesh
+self-provisioned in a subprocess when this session has one device:
+ring-vs-gather transports (bit-identity checked), one-device-vs-mesh
+wall-clock on the identical workload (``multichip_scaling_d8_vs_d1``),
+the gather collective timed alone per block (``gram.gather_wait_s`` →
+``multichip_overlap_frac``), and the row-sharded solve stages at the
+N=100k sketch shape (``multichip_solve_n100k_s``). ``--multichip-only``
+runs just this row (exit 1 unless ``multichip_ok``); see README
+"Multi-chip execution".
+
 Every run APPENDS its headline (plus git sha / argv / platform
 provenance) to the append-only ``BENCH_HISTORY.jsonl``; ``--trend``
 additionally gates the run against the trailing history with the
@@ -876,6 +887,204 @@ def bench_tile_solve() -> dict:
     }
 
 
+def _multichip_measure() -> dict:
+    """The measured multi-chip row (NOT a dryrun): the real tile2d
+    sharded gram path — host-fed packed blocks, variant-sharded
+    placement, both ICI transports — at config-3-scale shapes on
+    whatever mesh exists (all local devices: real chips when present,
+    the 8-virtual-device CPU mesh in CI), against the same workload on
+    ONE device. Also measures the row-sharded solve stages
+    (solvers/solve.stage_runtimes) at the N=100k sketch shape.
+
+    What each number means:
+
+    - ``gram_mb_s``: dense-equivalent ingest rate of the best-transport
+      mesh pass (the whole loop: host block -> sharded placement ->
+      update);
+    - ``scaling_d8_vs_d1``: one-device wall / mesh wall on the
+      IDENTICAL workload — device count actually buying wall-clock.
+      On real chips this approaches the device count; on the virtual
+      CPU mesh the same host cores back every "device", so parity-or-
+      better is the honest bar (the tile2d win there is cache locality:
+      8 small hot tiles instead of one N x N-sized accumulator);
+    - ``overlap_frac``: 1 - gather_wait / compute, from REAL gather-wait
+      telemetry — the bulk all_gather is timed alone per block
+      (gram_sharded.make_gather_probe -> ``gram.gather_wait_s``) against
+      the ring pass's block period, i.e. the fraction of the block the
+      ring schedule keeps chips computing instead of waiting;
+    - ``ring_identical``: ring-vs-gather accumulators compared exactly
+      (int32 pieces — the bit-identity contract, also pinned per kernel
+      by tests/test_parallel.py).
+    """
+    from spark_examples_tpu.core import meshes, telemetry
+    from spark_examples_tpu.core.profiling import hard_sync
+    from spark_examples_tpu.ingest import bitpack
+    from spark_examples_tpu.parallel import gram_sharded
+    from spark_examples_tpu.solvers import solve as solve_mod
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    mesh = meshes.make_mesh()
+    if backend == "cpu":
+        # Virtual-device CI mesh: big enough that the tile2d cache-
+        # locality effect is real (N=4096: 64 MB accumulator piece vs
+        # 8 MB tiles), small enough to stay inside a bench budget.
+        n, v_blk, n_blocks = 4096, 1024, 2
+    else:
+        n, v_blk, n_blocks = 10_240, 8192, 4
+    solve_n, solve_rank = 102_400, 96
+    metric = METRIC
+    log(f"multichip: mesh {mesh.devices.shape} ({backend}), "
+        f"{n}x{v_blk}x{n_blocks} {metric} gram")
+
+    rng = np.random.default_rng(11)
+    g = rng.integers(0, 3, size=(n, v_blk * n_blocks), dtype=np.int8)
+    g[rng.random(g.shape) < 0.01] = -1
+    pblocks = [
+        bitpack.pack_dosages(g[:, s:s + v_blk])
+        for s in range(0, g.shape[1], v_blk)
+    ]
+
+    plan8 = gram_sharded.GramPlan(mesh, "tile2d")
+    plan1 = gram_sharded.GramPlan(
+        meshes.make_mesh(jax.devices()[:1]), "replicated")
+
+    def timed_pass(plan, transport, reps=2):
+        upd = gram_sharded.make_update(plan, metric, packed=True,
+                                       transport=transport)
+        acc = gram_sharded.init_sharded(plan, n, metric)
+        for pb in pblocks:  # compile + warm at the real shapes
+            acc = upd(acc, pb)
+        hard_sync(acc)
+        best = float("inf")
+        for _ in range(reps):  # min-of-reps, symmetric for every pass
+            acc = gram_sharded.init_sharded(plan, n, metric)
+            t0 = time.perf_counter()
+            for pb in pblocks:
+                acc = upd(acc, pb)
+            hard_sync(acc)
+            best = min(best, time.perf_counter() - t0)
+        return best, {k: np.asarray(v) for k, v in acc.items()}
+
+    wall_d1, _ = timed_pass(plan1, "gather")
+    wall_gather, acc_gather = timed_pass(plan8, "gather")
+    wall_ring, acc_ring = timed_pass(plan8, "ring")
+    ring_identical = all(
+        np.array_equal(acc_gather[k], acc_ring[k]) for k in acc_gather
+    )
+    ring_steps = telemetry.counter_value("gram.ring_steps")
+
+    # The gather transport's collective, timed ALONE at the block
+    # cadence: place each packed block variant-sharded, then run just
+    # the bulk all_gather the gather transport pays in front of every
+    # contraction. This is the measured wait the ring schedule hides.
+    probe = gram_sharded.make_gather_probe(
+        plan8, n, pblocks[0].shape[1], packed=True)
+    dev_blocks = [jax.device_put(pb, plan8.block_sharding)
+                  for pb in pblocks]
+    hard_sync(probe(dev_blocks[0]))  # compile + warm, once
+    gather_wait = 0.0
+    for dev in dev_blocks:
+        t0 = time.perf_counter()
+        hard_sync(probe(dev))
+        dt = time.perf_counter() - t0
+        telemetry.observe("gram.gather_wait_s", dt)
+        gather_wait += dt
+    overlap_frac = max(0.0, min(1.0, 1.0 - gather_wait / max(wall_ring,
+                                                             1e-9)))
+    telemetry.gauge_set("gram.overlap_frac", overlap_frac)
+
+    auto = gram_sharded.resolve_transport(plan8, metric, n, v_blk, True)
+    best_transport = "ring" if wall_ring <= wall_gather else "gather"
+    wall_d8 = min(wall_ring, wall_gather)
+    dense_bytes = float(n) * v_blk * n_blocks  # decoded-equivalent int8
+    gram_mb_s = dense_bytes / wall_d8 / 1e6
+    scaling = wall_d1 / wall_d8
+
+    log(f"multichip gram: d1 {wall_d1:.2f}s, d{n_dev} gather "
+        f"{wall_gather:.2f}s / ring {wall_ring:.2f}s (identical="
+        f"{ring_identical}), scaling {scaling:.2f}x, {gram_mb_s:.0f} "
+        f"MB/s dense-equivalent, gather-wait {gather_wait * 1e3:.1f} ms "
+        f"-> overlap {overlap_frac:.3f}")
+
+    # Row-sharded solve stages at the 100k sketch shape: the same jits
+    # the production sketch ladder runs, on the mesh vs one device.
+    solve_mesh = solve_mod.stage_runtimes(solve_n, solve_rank, plan8,
+                                          k=K, repeats=2)
+    solve_d1 = solve_mod.stage_runtimes(solve_n, solve_rank, None,
+                                        k=K, repeats=2)
+    solve_total = sum(solve_mesh.values())
+    log(f"multichip solve (N={solve_n}, r={solve_rank}): mesh "
+        + json.dumps({k: round(v, 3) for k, v in solve_mesh.items()})
+        + " vs d1 "
+        + json.dumps({k: round(v, 3) for k, v in solve_d1.items()}))
+
+    return {
+        "backend": backend,
+        "n_devices": n_dev,
+        "mesh": list(mesh.devices.shape),
+        "n_samples": n,
+        "block_variants": v_blk,
+        "n_blocks": n_blocks,
+        "metric": metric,
+        "gram_wall_d1_s": round(wall_d1, 3),
+        "gram_wall_gather_s": round(wall_gather, 3),
+        "gram_wall_ring_s": round(wall_ring, 3),
+        "transport_best": best_transport,
+        "transport_auto": auto,
+        "ring_identical": bool(ring_identical),
+        "ring_steps": int(ring_steps),
+        "gram_mb_s": round(gram_mb_s, 1),
+        "scaling_d8_vs_d1": round(scaling, 3),
+        "gather_wait_s": round(gather_wait, 4),
+        "overlap_frac": round(overlap_frac, 4),
+        "solve_n100k": {
+            "n": solve_n, "rank": solve_rank,
+            "mesh": {k: round(v, 4) for k, v in solve_mesh.items()},
+            "d1": {k: round(v, 4) for k, v in solve_d1.items()},
+            "mesh_total_s": round(solve_total, 4),
+        },
+        "note": (
+            "measured (non-dryrun) sharded path on the ambient mesh — "
+            "real chips when present, 8 virtual CPU devices in CI "
+            "(same host cores behind every device: parity-or-better "
+            "is the honest scaling bar there; tile2d's win is cache "
+            "locality); overlap_frac from the gather collective timed "
+            "alone per block vs the ring pass's block period"
+        ),
+    }
+
+
+def bench_multichip() -> dict:
+    """``--multichip``: the measured multi-chip row. Runs in-process
+    when this session already has a mesh (>= 2 devices); a single-
+    device session (one dev chip, plain CPU) self-provisions the
+    8-virtual-device CPU mesh in a SUBPROCESS — the virtual platform
+    must be forced before the backend initializes, and this process's
+    backend is long since live (same constraint dryrun_multichip
+    documents)."""
+    if len(jax.devices()) >= 2:
+        return _multichip_measure()
+    import subprocess
+
+    log("multichip: single-device session -> 8-virtual-device CPU "
+        "subprocess")
+    env = dict(os.environ)
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multichip-child"],
+        capture_output=True, text=True, timeout=3000, env=env, cwd=REPO,
+    )
+    if p.returncode != 0:
+        raise RuntimeError(
+            f"multichip child failed rc={p.returncode}: "
+            f"{p.stderr[-2000:]}"
+        )
+    for line in p.stderr.splitlines():
+        log(f"  [child] {line}")
+    last = [ln for ln in p.stdout.splitlines() if ln.strip()][-1]
+    return json.loads(last)
+
+
 def bench_streaming(store: str) -> dict:
     """Config 5: incremental PCoA on a 256k-variant prefix.
 
@@ -1410,6 +1619,32 @@ def check_structure(coords: np.ndarray) -> float:
     return between / within
 
 
+def _multichip_headline(mc: dict) -> dict:
+    """Headline keys of one multichip measure record — shared by the
+    full-bench wiring and --multichip-only so the recorded row is the
+    same either way. ``multichip_ok`` is the acceptance gate: ring
+    bit-identical to gather AND device count not losing wall-clock —
+    strict (scaling >= 1.0) on real multi-device backends, where each
+    device brings its own compute; parity-with-noise-tolerance on the
+    virtual CPU mesh, where the SAME host cores back every "device"
+    (a single XLA CPU device already multithreads its matmuls across
+    them, so same-workload strong scaling is physically capped at ~1.0
+    there — measured 0.93–1.01 on the 2-core CI container; the row
+    still proves the real sharded path runs, bit-identically, at a
+    real measured rate)."""
+    floor = 1.0 if mc["backend"] != "cpu" else 0.85
+    return {
+        "metric": "multichip_" + mc["metric"] + "_gram",
+        "multichip_gram_mb_s": mc["gram_mb_s"],
+        "multichip_scaling_d8_vs_d1": mc["scaling_d8_vs_d1"],
+        "multichip_overlap_frac": mc["overlap_frac"],
+        "multichip_solve_n100k_s": mc["solve_n100k"]["mesh_total_s"],
+        "multichip_ok": bool(
+            mc["ring_identical"] and mc["scaling_d8_vs_d1"] >= floor
+        ),
+    }
+
+
 def _argv_value(flag: str) -> str | None:
     """Both GNU forms: ``--flag value`` and ``--flag=value``. A present
     flag with a missing/empty/flag-like value aborts up front — arming
@@ -1434,6 +1669,40 @@ def _argv_value(flag: str) -> str | None:
 
 def main() -> None:
     from spark_examples_tpu.core import telemetry
+
+    if "--multichip-child" in sys.argv:
+        # Subprocess mode of bench_multichip: provision the virtual
+        # CPU mesh BEFORE the backend initializes, measure, print one
+        # JSON line for the parent.
+        from spark_examples_tpu.core.virtual import force_virtual_cpu
+
+        force_virtual_cpu(8)
+        print(json.dumps(_multichip_measure()))
+        return
+
+    if "--multichip-only" in sys.argv:
+        # The standalone multi-chip row (CI / real-pod runs that do not
+        # need the full config sweep): measure, record to the history
+        # backend-tagged, print the same two-line stdout contract.
+        mc = bench_multichip()
+        headline = _multichip_headline(mc)
+        from tools import trend as trend_mod
+
+        history_path = os.path.join(REPO, trend_mod.HISTORY_FILE)
+        try:
+            trend_mod.append_history(history_path, headline, run_meta={
+                "argv": sys.argv[1:],
+                "backend": jax.default_backend(),
+                "device": str(jax.devices()[0].device_kind),
+            })
+        except OSError as e:
+            log(f"{trend_mod.HISTORY_FILE} not appended ({e})")
+        full = {**headline, "configs": {"multichip": mc}}
+        print(json.dumps(full))
+        print(json.dumps(headline))
+        if not headline["multichip_ok"]:
+            raise SystemExit(1)
+        return
 
     telemetry_dir = _argv_value("--telemetry-dir")
     if telemetry_dir:
@@ -1560,6 +1829,13 @@ def main() -> None:
             log(f"kernels FAILED: {e!r}")
             configs["kernels"] = {"error": repr(e)}
 
+    if "--multichip" in sys.argv:
+        try:
+            configs["multichip"] = bench_multichip()
+        except Exception as e:
+            log(f"multichip FAILED: {e!r}")
+            configs["multichip"] = {"error": repr(e)}
+
     # Every TPU path whose time is reported must also recover the planted
     # structure — a fast wrong answer must not print a speedup.
     checks = [
@@ -1666,6 +1942,11 @@ def main() -> None:
             and configs["store"]["store_hit_vs_cold_parse"] >= 3.0
             and configs["store"]["compact_deterministic_w4_vs_w1"]
         )
+    if "multichip" in configs and "error" not in configs["multichip"]:
+        headline.update(_multichip_headline(configs["multichip"]))
+        # Keep the full bench's own headline metric name — the
+        # multichip keys ride along as fields.
+        headline["metric"] = "ibs_pcoa_chip_2504x1M"
     if "kernels" in configs and "error" not in configs["kernels"]:
         per = configs["kernels"]["per_kernel"]
         # The two kernels the registry PR ships/highlights ride the

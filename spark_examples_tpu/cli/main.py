@@ -187,6 +187,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="IxJ, e.g. 2x4 (default: auto-factor devices)")
     c.add_argument("--gram-mode", default="auto",
                    choices=["auto", "replicated", "variant", "tile2d"])
+    c.add_argument("--tile2d-transport", default="auto",
+                   choices=["auto", "gather", "ring"],
+                   help="tile2d block reassembly over ICI: 'gather' = "
+                   "one bulk all_gather serially before each "
+                   "contraction; 'ring' = ppermute ring schedule "
+                   "hiding each shard hop behind the previous shard's "
+                   "contraction (bit-identical for count kernels); "
+                   "'auto' = ring when the kernel's FLOPs model says "
+                   "the contraction outweighs the hop (see README "
+                   "'Multi-chip execution')")
     c.add_argument("--eigh-mode", default="auto",
                    choices=["auto", "dense", "randomized"])
     c.add_argument("--eigh-iters", type=int,
@@ -325,6 +335,7 @@ def _job_from_args(args) -> JobConfig:
             num_pc=args.num_pc,
             mesh_shape=mesh_shape,
             gram_mode=args.gram_mode,
+            tile2d_transport=args.tile2d_transport,
             eigh_mode=args.eigh_mode,
             eigh_iters=args.eigh_iters,
             eigh_oversample=args.eigh_oversample,

@@ -283,6 +283,15 @@ class ComputeConfig:
     pack_stream: str = "auto"  # auto | packed | dense
     mesh_shape: tuple[int, int] | None = None  # None -> auto-factor devices
     gram_mode: str = "auto"  # auto | replicated | variant | tile2d
+    # tile2d block reassembly over ICI (parallel/gram_sharded): "gather"
+    # = one bulk all_gather serially in front of every contraction;
+    # "ring" = a ppermute ring schedule contracting each shard while the
+    # next rotates in (the hop hides behind the MXU — bit-identical to
+    # gather for int32-accumulating kernels, allclose for grm); "auto"
+    # picks ring when the kernel's FLOPs model says one ring step's
+    # contraction outweighs a shard hop (resolve_transport). Ignored
+    # outside tile2d sharded-block plans.
+    tile2d_transport: str = "auto"  # auto | gather | ring
     eigh_mode: str = "auto"  # auto | dense | randomized
     # Randomized-solver knobs (power iterations / subspace oversample).
     # Defaults meet the documented accuracy contract (structure
@@ -329,6 +338,16 @@ class ComputeConfig:
                     f"integer in [{lo}, {hi}] ({why})"
                 )
 
+        if self.tile2d_transport not in ("auto", "gather", "ring"):
+            raise ValueError(
+                f"bad compute config: --tile2d-transport="
+                f"{self.tile2d_transport!r} — expected auto | gather | "
+                "ring (gather = bulk all_gather before each contraction; "
+                "ring = ppermute schedule overlapping each shard hop "
+                "with the previous shard's contraction; auto = ring when "
+                "the kernel's FLOPs model says the contraction hides "
+                "the hop)"
+            )
         _check("--sketch-rank", self.sketch_rank, 1, 65536,
                "range-sketch probe columns; clamped to N at run time")
         _check("--sketch-iters", self.sketch_iters, 0, 1000,

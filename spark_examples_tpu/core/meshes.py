@@ -156,3 +156,15 @@ def variants_flat(mesh: Mesh) -> NamedSharding:
     whole mesh — the data-parallel axis (reference: RDD partitions by
     genomic range, SURVEY.md §2.2)."""
     return NamedSharding(mesh, P(None, (AXIS_I, AXIS_J)))
+
+
+def ring_perm(mesh: Mesh) -> tuple[tuple[int, int], ...]:
+    """``ppermute`` source→destination pairs rotating one hop around the
+    flattened ``(i, j)`` device ring: the shard on device ``s`` moves to
+    device ``s - 1`` (mod D), so after ``D - 1`` hops every device has
+    held every shard exactly once — the schedule of the tile2d ring
+    transport (parallel/gram_sharded), where each hop rides ICI *behind*
+    the current shard's tile contraction instead of serializing in front
+    of it the way the bulk ``all_gather`` does."""
+    n = mesh.devices.size
+    return tuple((s, (s - 1) % n) for s in range(n))

@@ -532,6 +532,34 @@ NAMES: dict[str, tuple[str, str]] = {
         "max_batch means coalescing is working; 1 means linger is too "
         "short for the offered load",
     ),
+    # -- multi-chip execution (tile2d transports + shard-aware feed) ------
+    "gram.ring_steps": (
+        "counter",
+        "tile2d ring-transport shard rotations dispatched (n_devices per "
+        "block update) — nonzero proves the overlapped schedule, not the "
+        "bulk gather, is the one running",
+    ),
+    "gram.gather_wait_s": (
+        "histogram",
+        "measured wall-clock of the tile2d gather transport's bulk block "
+        "all_gather alone, at the job's block cadence (bench --multichip "
+        "times gram_sharded.make_gather_probe) — the serial collective "
+        "cost the ring transport hides behind the MXU",
+    ),
+    "gram.overlap_frac": (
+        "gauge",
+        "1 - gather_wait / block compute for the measured multi-chip gram "
+        "(bench --multichip): the fraction of the block period the ring "
+        "schedule keeps the chips computing instead of waiting on the "
+        "block collective",
+    ),
+    "multihost.shard_feed_bytes": (
+        "counter",
+        "bytes THIS process fed into the mesh as its own variant-shard "
+        "slabs (padding steps feed none) — summed across hosts, the "
+        "aggregate-ingest number that scales with host count under the "
+        "shard-aware feed",
+    ),
 }
 
 _FAMILIES = tuple(n[:-1] for n in NAMES if n.endswith(".*"))  # "phase."

@@ -194,11 +194,14 @@ def stream_host_blocks(
     stay host-resident (and unstaged: the consumer owns each block
     indefinitely, so the reusable ring cannot apply). The multi-host
     feeder consumes this directly (each process assembles its slab into
-    a global array itself).
+    a global array itself); dense store-backed partitions take the
+    decode-straight-into-buffer drive (``direct=True``) so each
+    process's slab is decoded in one native call from exactly its
+    window's variants — the shard-aware feed.
     """
     for host, _slot, meta in _produce_host_blocks(
         source, block_variants, start_variant, prefetch, pad_multiple,
-        pack, stats, staging=False,
+        pack, stats, staging=False, direct=True,
     ):
         yield host, meta
 
@@ -309,7 +312,7 @@ def stream_to_device(
 
 def _produce_host_blocks(
     source, block_variants, start_variant, prefetch, pad_multiple, pack,
-    stats, staging=False,
+    stats, staging=False, direct=False,
 ):
     """The producer thread: yields ``(host_array, slot | None, meta)``.
 
@@ -317,6 +320,12 @@ def _produce_host_blocks(
     fresh host buffer per block (dense padding, host-side 2-bit
     packing); the zero-copy packed-source path stays unstaged — its
     blocks are read-only mmap views, already stable host memory.
+    ``direct`` opts an UNSTAGED dense stream into the store's
+    decode-straight-into-buffer drive (fresh consumer-owned buffer per
+    block) — the multi-host per-process feed's path, where each host
+    decodes only its window's variants with zero intermediate copies;
+    single-host unstaged streams keep the ordinary blocks() path (and
+    its decode-cache population) unchanged.
     """
     q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
     stop = threading.Event()
@@ -328,17 +337,22 @@ def _produce_host_blocks(
         and hasattr(source, "packed_blocks")
         and block_variants % bitpack.VARIANTS_PER_BYTE == 0
     )
-    # Dense staged store streams skip the source's own block
-    # materialization entirely: the producer drives the store's
-    # decode_range_into against the staging slab, so a cold chunk
-    # inflates + unpacks STRAIGHT into the slab in one native call
-    # (store/codec.py) — no per-block dense buffer, no copy-to-slab.
-    # Capability-detected: StoreSource advertises it, and the retry
-    # boundary (the DEFAULT wrapper) forwards it under its own budget
-    # (ingest/resilient.py); other wrappers (filters) take the
-    # ordinary path below, bit-identically.
+    # Dense store streams skip the source's own block materialization
+    # entirely: the producer drives the store's decode_range_into
+    # against the destination buffer, so a cold chunk inflates +
+    # unpacks STRAIGHT into it in one native call (store/codec.py) —
+    # no per-block dense intermediate, no decode-then-slice-then-pad
+    # copy chain. Staged placements decode into the reusable ring
+    # slab; unstaged ones (CPU targets, host-block consumers like the
+    # multi-host per-process feed) decode into a fresh MISSING-filled
+    # buffer the consumer owns outright — either way the per-block
+    # copies collapse to zero. Capability-detected: StoreSource,
+    # its range/window shares, and the retry boundary (the DEFAULT
+    # wrapper) all advertise it (ingest/resilient.py, ingest/source.py,
+    # store/reader.py); other wrappers (filters) take the ordinary
+    # path below, bit-identically.
     decode_direct = (
-        staging
+        (staging or direct)
         and not pack
         and hasattr(source, "decode_range_into")
         and hasattr(source, "block_spans")
@@ -393,7 +407,7 @@ def _produce_host_blocks(
 
     def produce():
         try:
-            if decode_direct and ring is not None:
+            if decode_direct:
                 if stats is not None:
                     # Store payloads are 2-bit dosages by construction:
                     # the dense-transport max-value guard's answer is
@@ -402,14 +416,25 @@ def _produce_host_blocks(
                 for lo, hi, meta in source.block_spans(
                     block_variants, start_variant
                 ):
-                    slot = ring.acquire(stop)
-                    if slot is None:
-                        return
+                    if ring is not None:
+                        slot = ring.acquire(stop)
+                        if slot is None:
+                            return
+                        buf = slot.buf
+                    else:
+                        # Unstaged (CPU placement / host-block
+                        # consumer): the consumer owns each block
+                        # indefinitely, so decode into a fresh buffer —
+                        # pre-filled MISSING, which doubles as the
+                        # ragged-tail pad.
+                        slot = None
+                        buf = np.full((source.n_samples, width),
+                                      MISSING, GENOTYPE_DTYPE)
                     w = hi - lo
-                    source.decode_range_into(lo, hi, slot.buf)
-                    if w < slot.buf.shape[1]:
-                        slot.buf[:, w:] = MISSING
-                    if not _put((slot.buf, slot, meta)):
+                    source.decode_range_into(lo, hi, buf)
+                    if slot is not None and w < buf.shape[1]:
+                        buf[:, w:] = MISSING
+                    if not _put((buf, slot, meta)):
                         return
             elif zero_copy:
                 w_bytes = width // bitpack.VARIANTS_PER_BYTE

@@ -232,6 +232,16 @@ class WindowSource:
         # (prefetch dispatches on hasattr).
         if hasattr(self.inner, "packed_blocks"):
             self.packed_blocks = self._packed_blocks
+        # Same capability pattern for the column-window decode path
+        # (store decode-straight-into-slab): a multi-host process whose
+        # partition is a window over a store (directly or through the
+        # retry boundary) then decodes ONLY its own variant slice into
+        # its staging slab — no full-chunk materialize, no post-decode
+        # slicing (parallel/multihost.py's shard-aware feed).
+        if hasattr(self.inner, "decode_range_into") and hasattr(
+                self.inner, "block_spans"):
+            self.block_spans = self._block_spans
+            self.decode_range_into = self._decode_range_into
 
     @property
     def n_samples(self) -> int:
@@ -281,6 +291,49 @@ class WindowSource:
         yield from self._relocalize(
             self.inner.blocks(block_variants, self.start + start_variant)
         )
+
+    def _block_spans(self, block_variants: int, start_variant: int = 0):
+        """Window-relocalized spans of the inner source's block grid —
+        (lo, hi, meta) in the WINDOW's coordinates, truncated at the
+        window end. The decode-free twin of :meth:`blocks` for callers
+        that drive :meth:`decode_range_into` into their own buffers."""
+        if self.start % block_variants:
+            raise ValueError(
+                f"window start {self.start} not aligned to block grid "
+                f"{block_variants} — inner cursors would ceil-align past "
+                "the window's own variants"
+            )
+        idx = 0
+        for lo, hi, meta in self.inner.block_spans(
+                block_variants, self.start + start_variant):
+            if lo >= self.stop:  # inner coordinates, like blocks()
+                break
+            hi = min(hi, self.stop)
+            take = hi - lo
+            pos = meta.positions
+            if pos is not None and take < len(pos):
+                pos = pos[:take]
+            yield lo - self.start, hi - self.start, dataclasses.replace(
+                meta,
+                index=idx,
+                start=lo - self.start,
+                stop=hi - self.start,
+                positions=pos,
+            )
+            idx += 1
+
+    def _decode_range_into(self, lo: int, hi: int, out, col_off: int = 0):
+        # Bounds-checked against the WINDOW, not just the inner source:
+        # an over-long span would otherwise silently decode another
+        # partition's variants (double-counted into the global
+        # accumulation in a multi-host job) instead of erroring.
+        if not 0 <= lo <= hi <= self.n_variants:
+            raise ValueError(
+                f"variant range [{lo}, {hi}) out of bounds for a "
+                f"{self.n_variants}-variant window"
+            )
+        self.inner.decode_range_into(self.start + lo, self.start + hi,
+                                     out, col_off)
 
     def _packed_blocks(self, block_variants: int, start_variant: int = 0):
         if self.start % block_variants:

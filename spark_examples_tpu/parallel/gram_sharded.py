@@ -19,15 +19,37 @@ is sharding annotations on the *same* jitted computation
 
   * ``"sharded"`` (default — host-streamed blocks): blocks arrive
     variant-sharded (each chip is fed 1/n_dev of the block over the
-    host link) and XLA all-gathers the block over ICI before each chip
+    host link) and the block is reassembled over ICI before each chip
     contracts its row-slice against its col-slice — host→device traffic
-    per chip drops by n_dev, and the gather rides ICI, orders of
+    per chip drops by n_dev, and the reassembly rides ICI, orders of
     magnitude faster than the host link. This is also exactly the
     transport the multi-host path needs: each process feeds only its
-    own variant slice (parallel/multihost.py). The gather IS a
-    collective in the hot loop; at 76k x 4096 int8 it moves ~0.3 GB/
-    block over ICI (~3 ms at v5e ICI rates) against ~10^13 FLOPs of
-    tile matmuls — <2 % of the update (BASELINE.md config 4).
+    own variant slice (parallel/multihost.py). HOW the shards reach
+    every chip is the ``transport`` choice (``make_update(transport=
+    ...)``, ``--tile2d-transport``):
+
+    - ``"gather"`` — one bulk ``all_gather`` of the (packed) block in
+      front of the contraction: the hot loop's only collective, but it
+      runs SERIALLY before every block's matmuls. At 76k x 4096 int8
+      it moves ~0.3 GB/block over ICI (~3 ms at v5e ICI rates) against
+      ~10^13 FLOPs of tile matmuls — <2 % of the update (BASELINE.md
+      config 4) — but the fraction grows as tiles shrink.
+    - ``"ring"`` — a ``ppermute`` ring schedule (arXiv:2112.09017's
+      gather-behind-the-MXU structure): each device contracts the
+      variant shard it currently holds against its row/col tile slices
+      while the next shard rotates in from its ring neighbor, so after
+      D - 1 hops every device has contracted the full block and every
+      hop overlapped a contraction. Shards stay 2-bit packed on the
+      wire exactly as the gather path gathers them packed. Summation
+      order is per-shard partial products added in ring order — int32
+      accumulation is exact under reordering, so every count-family
+      kernel is BIT-identical to the gather transport (pinned by
+      tests/test_parallel.py); grm's f32 accumulation agrees to
+      float tolerance.
+    - ``"auto"`` (the config default) — ring when the plan's FLOPs
+      model says one ring step's contraction outweighs a shard hop
+      (:func:`resolve_transport`), gather otherwise (tiny tiles, where
+      D small hops cost more latency than one bulk collective).
   * ``"replicated"`` (staged/on-device blocks): the block is already
     fully present on every chip (generated on device, or staged once),
     each chip slices its row/col operands locally, and the hot loop
@@ -51,7 +73,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_examples_tpu import kernels
-from spark_examples_tpu.core import meshes
+from spark_examples_tpu.core import meshes, telemetry
 from spark_examples_tpu.ops import gram as gram_ops
 
 # Rough per-chip HBM budget for resident accumulators (bytes).
@@ -169,8 +191,8 @@ def init_sharded(plan: GramPlan, n: int, metric: str):
 
 
 def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
-                           grm_precise: bool, gather_block: bool):
-    """The tile2d update as an explicit shard_map, for both transports.
+                           grm_precise: bool, transport: str):
+    """The tile2d update as an explicit shard_map, for all transports.
 
     Relying on jit + sharding annotations here lets XLA's SPMD
     partitioner pick pathological lowerings (observed on the CPU mesh):
@@ -182,25 +204,38 @@ def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
     packed) the design intends. shard_map makes the choreography
     explicit:
 
-    - ``gather_block=True`` (variant-sharded transport): one
-      ``all_gather`` of the (packed) block over the flattened mesh —
-      the hot loop's ONLY collective, gathered in the 2-bit domain when
-      the stream is packed so it costs n*v/4 bytes;
-    - ``gather_block=False`` (replicated/staged transport): no
-      collective at all.
+    - ``transport="gather"`` (variant-sharded blocks, bulk reassembly):
+      one ``all_gather`` of the (packed) block over the flattened mesh
+      — the hot loop's ONLY collective, gathered in the 2-bit domain
+      when the stream is packed so it costs n*v/4 bytes, but run
+      serially in front of every contraction;
+    - ``transport="ring"`` (variant-sharded blocks, overlapped
+      reassembly): D - 1 ``ppermute`` hops around the flattened device
+      ring (meshes.ring_perm), each device contracting the shard it
+      holds while the next one rotates in — the hop is issued BEFORE
+      the contraction so XLA's scheduler hides it behind the matmuls;
+      shards stay packed on the wire. Per-shard partial products are
+      added in ring order: int32 sums are exact under reordering
+      (count family bit-identical to gather); grm's f32 agrees to
+      float tolerance, and its per-variant standardization statistics
+      are per-COLUMN — each device holds all N sample rows of its
+      current shard — so they are identical math either way;
+    - ``transport="none"`` (replicated/staged blocks): no collective
+      at all.
 
-    Either way each device then slices its row/col sample ranges out of
-    the full block and contracts them locally with
+    Either way each device slices its row/col sample ranges out of the
+    (full or per-shard) block and contracts them locally with
     :func:`genotype.tile_products`. Compile-checked by
     tests/test_parallel.py.
     """
-    import jax.numpy as jnp
+    import jax.numpy as jnp  # noqa: F401 (kernel tile bodies expect jnp up)
     from jax.sharding import PartitionSpec as P
 
     from spark_examples_tpu.ops import genotype
 
     mesh = plan.mesh
     n_i, n_j = mesh.devices.shape
+    n_dev = n_i * n_j
     kern = kernels.get(metric)
     acc_specs = {
         k: (P() if k in kern.scalar_leaves
@@ -208,35 +243,71 @@ def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
         for k in kern.acc_leaves
     }
     block_spec = (
-        P(None, (meshes.AXIS_I, meshes.AXIS_J)) if gather_block else P()
+        P() if transport == "none"
+        else P(None, (meshes.AXIS_I, meshes.AXIS_J))
     )
 
-    def body(acc, block):
-        if gather_block:
-            # One explicit gather of the variant shards (i major, j
-            # minor — the same order P(None, ("i", "j")) split them).
-            block = jax.lax.all_gather(
-                block, (meshes.AXIS_I, meshes.AXIS_J), axis=1, tiled=True
-            )
+    def unpack(chunk):
         if packed:
             from spark_examples_tpu.ingest.bitpack import unpack_dosages
 
-            block = unpack_dosages(block)
+            return unpack_dosages(chunk)
+        return chunk
+
+    def contract(acc, chunk, i, j, tn, tm):
+        """Fold one RAW (packed or dense) full-or-shard chunk into the
+        tile accumulators — shared by every transport; the chunk's
+        variant width is whatever the transport delivers.
+
+        Count-family kernels slice their row/col sample ranges BEFORE
+        unpacking (the sample axis is axis 0 of the packed byte layout
+        too, so slice-then-unpack is bit-identical to
+        unpack-then-slice): per device that is (tn + tm) x v of 2-bit
+        expansion instead of n x v — the full-block unpack was
+        replicated VPU work on every device. Float-family kernels
+        (GRM) need whole-chunk per-variant statistics and keep the
+        full unpack."""
+        if kern.family == "float":
+            return kern.tile_body(acc, unpack(chunk), i, j, tn, tm,
+                                  grm_precise)
+        rows = jax.lax.dynamic_slice_in_dim(chunk, i * tn, tn, axis=0)
+        cols = jax.lax.dynamic_slice_in_dim(chunk, j * tm, tm, axis=0)
+        prods = genotype.tile_products(unpack(rows), unpack(cols),
+                                       tuple(acc_specs))
+        return {k: acc[k] + prods[k] for k in acc_specs}
+
+    def body(acc, block):
         i = jax.lax.axis_index(meshes.AXIS_I)
         j = jax.lax.axis_index(meshes.AXIS_J)
         n = block.shape[0]
         check_tile_divisible(n, mesh)  # trace-time; shapes are concrete
         tn, tm = n // n_i, n // n_j
-        if kern.family == "float":
-            # Float-family kernels (GRM) supply their own tile body —
-            # e.g. standardization statistics from the FULL block (per-
-            # variant, over all N samples — replicated work, identical
-            # on every device), then only the tile's slices on the MXU.
-            return kern.tile_body(acc, block, i, j, tn, tm, grm_precise)
-        rows = jax.lax.dynamic_slice_in_dim(block, i * tn, tn, axis=0)
-        cols = jax.lax.dynamic_slice_in_dim(block, j * tm, tm, axis=0)
-        prods = genotype.tile_products(rows, cols, tuple(acc_specs))
-        return {k: acc[k] + prods[k] for k in acc_specs}
+        if transport == "ring":
+            # The overlapped schedule: contract the shard in hand while
+            # the next rotates in. The hop is issued FIRST so the
+            # collective-permute can ride behind the contraction's
+            # matmuls (latency-hiding scheduler on real chips; on the
+            # CPU mesh the schedule is still bit-identical, just
+            # unoverlapped). Shards hop in their transport dtype —
+            # 2-bit packed bytes when the stream is packed.
+            perm = meshes.ring_perm(mesh)
+            shard = block
+            for s in range(n_dev):
+                nxt = (
+                    jax.lax.ppermute(
+                        shard, (meshes.AXIS_I, meshes.AXIS_J), perm)
+                    if s < n_dev - 1 else None
+                )
+                acc = contract(acc, shard, i, j, tn, tm)
+                shard = nxt
+            return acc
+        if transport == "gather":
+            # One explicit gather of the variant shards (i major, j
+            # minor — the same order P(None, ("i", "j")) split them).
+            block = jax.lax.all_gather(
+                block, (meshes.AXIS_I, meshes.AXIS_J), axis=1, tiled=True
+            )
+        return contract(acc, block, i, j, tn, tm)
 
     return meshes.shard_map(
         body, mesh=mesh, in_specs=(acc_specs, block_spec),
@@ -246,21 +317,30 @@ def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
 
 @lru_cache(maxsize=64)
 def _jitted_update(plan: GramPlan, metric: str, packed: bool,
-                   grm_precise: bool = False, block_layout: str = "sharded"):
-    """One jit wrapper per (plan, metric, packed, grm_precise, layout) —
-    re-entering the same job shape reuses the compiled executable instead
-    of re-tracing (a fresh ``jax.jit`` object owns a fresh compilation
-    cache)."""
+                   grm_precise: bool = False, block_layout: str = "sharded",
+                   transport: str = "gather"):
+    """One jit wrapper per (plan, metric, packed, grm_precise, layout,
+    transport) — re-entering the same job shape reuses the compiled
+    executable instead of re-tracing (a fresh ``jax.jit`` object owns a
+    fresh compilation cache). The donated accumulator aliases cleanly in
+    every variant here (same leaf dtypes/shardings in and out); the
+    N x N stages whose outputs CANNOT alias their inputs live in
+    parallel/pcoa_sharded.py, which donates only the alias-able leaves
+    (tests/test_parallel.py asserts the whole sharded route compiles
+    with no unusable-donation warnings)."""
     acc_sh = _acc_shardings(plan, metric)
     if plan.mode == "tile2d" and plan.mesh.devices.size > 1:
-        gather = block_layout == "sharded"
+        sm_transport = (
+            "none" if block_layout == "replicated" else transport
+        )
         return jax.jit(
             _tile2d_shard_map_impl(plan, metric, packed, grm_precise,
-                                   gather_block=gather),
+                                   transport=sm_transport),
             in_shardings=(
                 acc_sh,
-                plan.block_sharding if gather
-                else meshes.replicated(plan.mesh),
+                meshes.replicated(plan.mesh)
+                if block_layout == "replicated"
+                else plan.block_sharding,
             ),
             out_shardings=acc_sh,
             donate_argnums=(0,),
@@ -277,8 +357,65 @@ def _jitted_update(plan: GramPlan, metric: str, packed: bool,
     )
 
 
+# Nominal accelerator compute-rate : ICI-rate ratio (FLOPs per byte) the
+# auto transport choice assumes: one ring step pays ~hop_bytes /
+# ICI-rate of (hidden) transfer against flops_step / MXU-rate of
+# contraction; the hop only disappears behind the matmuls when
+# flops_step / hop_bytes clears this ratio. ~512 matches a v5e-class
+# chip (~2e14 int8 FLOP/s against ~4e11 B/s of per-link ICI); the exact
+# value only moves the crossover shape, and both transports are always
+# forcible (--tile2d-transport gather|ring).
+RING_FLOP_PER_BYTE = 512.0
+
+TILE2D_TRANSPORTS = ("gather", "ring", "auto")
+
+
+def resolve_transport(plan: GramPlan, metric: str, n_samples: int,
+                      block_variants: int, packed: bool) -> str:
+    """The ``auto`` tile2d transport choice, from the kernel's own FLOPs
+    model: ring when one ring step's tile contraction outweighs one
+    shard hop at the nominal :data:`RING_FLOP_PER_BYTE` rate ratio (the
+    hop then hides behind the MXU), gather otherwise (tiny tiles — D
+    small hops cost more latency than one bulk collective). Non-tile2d
+    plans and single-device meshes have no transport choice at all."""
+    if plan.mode != "tile2d" or plan.mesh.devices.size <= 1:
+        return "gather"
+    n_dev = plan.mesh.devices.size
+    kern = kernels.get(metric)
+    # Per-device, per-ring-step contraction: the block's total matmul
+    # FLOPs spread over n_dev tiles and n_dev shards.
+    flops_step = kern.flops(n_samples, block_variants) / (n_dev * n_dev)
+    hop_bytes = n_samples * block_variants / n_dev / (4 if packed else 1)
+    return "ring" if flops_step >= RING_FLOP_PER_BYTE * hop_bytes \
+        else "gather"
+
+
+def check_ring_divisible(block_width: int, plan: GramPlan,
+                         packed: bool) -> None:
+    """Ring transport needs the shard count to divide the block's
+    variant width (each device must hold an equal shard to rotate).
+    The streamed feeds guarantee this by padding (pad_multiple =
+    plan.block_shards), so this names the flags for DIRECT callers —
+    instead of the raw shard_map sharding error that otherwise
+    surfaces deep inside tracing."""
+    n_dev = plan.mesh.devices.size
+    if n_dev > 1 and block_width % n_dev:
+        unit = "packed bytes" if packed else "variants"
+        raise ValueError(
+            f"--tile2d-transport ring cannot rotate a block of "
+            f"{block_width} {unit} over the {n_dev}-device mesh: the "
+            f"shard count must divide the block's variant width "
+            f"({block_width} % {n_dev} = {block_width % n_dev}). Fix: "
+            f"pick --block-variants a multiple of "
+            f"{n_dev * (4 if packed else 1)} (the streamed feeds pad to "
+            "this grid automatically; direct update calls must pad "
+            "their own blocks — prefetch.pad_block/pad_packed)"
+        )
+
+
 def make_update(plan: GramPlan, metric: str, packed: bool = False,
-                grm_precise: bool = False, block_layout: str = "sharded"):
+                grm_precise: bool = False, block_layout: str = "sharded",
+                transport: str = "gather"):
     """Jitted ``(acc, block) -> acc`` with the plan's shardings pinned.
 
     The computation is byte-identical to the single-chip path. Variant
@@ -295,25 +432,50 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False,
 
     ``block_layout``: how blocks reach the update. ``"sharded"`` (the
     host-streamed transport) shards the variant axis across the mesh —
-    in tile2d mode XLA all-gathers it over ICI inside the update.
-    ``"replicated"`` declares the block already fully present on every
-    device (staged/on-device generation): tile2d chips then slice their
-    operands locally and the hot loop compiles with NO collectives
-    (compile-checked by tests/test_parallel.py). Only meaningful for
-    tile2d; variant mode's psum is its compute, not its transport, so
-    replicated blocks are rejected there rather than silently computing
-    the whole N x N redundantly on every chip.
+    tile2d mode then reassembles it over ICI inside the update per
+    ``transport``. ``"replicated"`` declares the block already fully
+    present on every device (staged/on-device generation): tile2d chips
+    then slice their operands locally and the hot loop compiles with NO
+    collectives (compile-checked by tests/test_parallel.py). Only
+    meaningful for tile2d; variant mode's psum is its compute, not its
+    transport, so replicated blocks are rejected there rather than
+    silently computing the whole N x N redundantly on every chip.
+
+    ``transport``: the tile2d sharded-layout reassembly — ``"gather"``
+    (one bulk all_gather in front of the contraction), ``"ring"`` (a
+    ppermute ring schedule hiding each hop behind the previous shard's
+    contraction; bit-identical for int32-accumulating kernels, allclose
+    for grm), or ``"auto"`` (:func:`resolve_transport` per block shape).
+    Ignored outside tile2d sharded layouts.
     """
     if block_layout not in ("sharded", "replicated"):
         raise ValueError(f"unknown block_layout {block_layout!r}")
+    if transport not in TILE2D_TRANSPORTS:
+        raise ValueError(
+            f"unknown tile2d transport {transport!r}; valid: "
+            f"{' | '.join(TILE2D_TRANSPORTS)}"
+        )
     if block_layout == "replicated" and plan.mode == "variant":
         raise ValueError(
             "block_layout='replicated' under a variant-mode plan would "
             "make every chip compute the full N x N product redundantly "
             "— use the sharded transport (or a tile2d plan)"
         )
-    jitted = _jitted_update(plan, metric, packed, grm_precise, block_layout)
+    ring = (
+        transport == "ring" and block_layout == "sharded"
+        and plan.mode == "tile2d" and plan.mesh.devices.size > 1
+    )
+    if transport == "auto":
+        # Direct make_update callers resolve per actual block width at
+        # call time via the runner; a bare "auto" here means the caller
+        # did not resolve — fall back to the gather transport, which
+        # every block shape supports.
+        transport = "gather"
+        ring = False
+    jitted = _jitted_update(plan, metric, packed, grm_precise, block_layout,
+                            "ring" if ring else "gather")
     n_shards = plan.block_shards
+    n_dev = plan.mesh.devices.size
     if block_layout == "replicated":
         want_sharding = meshes.replicated(plan.mesh)
 
@@ -344,7 +506,42 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False,
                     pad_packed(block, width) if packed
                     else pad_block(block, width)
                 )
+        if ring:
+            # Caught BEFORE tracing, with the flags named (the satellite
+            # contract): a pre-sharded jax.Array skipped the pad above.
+            check_ring_divisible(block.shape[1], plan, packed)
+            telemetry.count("gram.ring_steps", n_dev)
+        if not isinstance(block, jax.Array) or (
+                block.sharding != plan.block_sharding):
             block = jax.device_put(block, plan.block_sharding)
         return jitted(acc, block)
 
     return update
+
+
+def make_gather_probe(plan: GramPlan, n_samples: int, width: int,
+                      packed: bool = False):
+    """A jitted program running ONLY the tile2d gather transport's bulk
+    block ``all_gather`` (no contraction): ``probe(block) -> gathered``
+    for a variant-sharded ``(n_samples, width)`` block. Timing it at the
+    job's block cadence is the measured gather-wait the ring transport
+    exists to hide — the numerator of ``gram.overlap_frac`` and the
+    ``gram.gather_wait_s`` histogram the multi-chip bench exports
+    (bench.py --multichip)."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(block):
+        return jax.lax.all_gather(
+            block, (meshes.AXIS_I, meshes.AXIS_J), axis=1, tiled=True
+        )
+
+    sm = meshes.shard_map(
+        body, mesh=plan.mesh,
+        in_specs=(P(None, (meshes.AXIS_I, meshes.AXIS_J)),),
+        out_specs=P(), check_vma=False,
+    )
+    return jax.jit(
+        sm,
+        in_shardings=(plan.block_sharding,),
+        out_shardings=meshes.replicated(plan.mesh),
+    )

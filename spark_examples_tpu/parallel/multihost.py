@@ -153,6 +153,17 @@ def stream_global_blocks(
     steps where this process had no data left (its slab was all-MISSING
     padding).
 
+    The slab itself is shard-aware for store-backed partitions: the
+    producer decodes the window's variants straight into the slab in
+    one native call (``stream_host_blocks`` ``direct`` drive →
+    ``decode_range_into``), so a host never decodes chunks its devices
+    do not consume and never slices/pads after decode — aggregate
+    ingest scales with host count. Per-slab bytes are exported as the
+    ``multihost.shard_feed_bytes`` counter, and assembly runs one block
+    ahead of the yield so the next block's per-shard device transfer
+    overlaps the current update (the tile2d ring schedule's host-side
+    double buffer).
+
     Control-plane cost: ONE upfront step-count allgather plus ONE
     terminal contract-agreement round when every process's source knows
     its length (``exact_n_variants``), else one
@@ -201,6 +212,12 @@ def stream_global_blocks(
             raise AssertionError(
                 f"local slab width {slab.shape[1]} != agreed {w_local}"
             )
+        if meta is not None:
+            # Aggregate-ingest accounting: bytes THIS process fed into
+            # the mesh (its own shard only — padding slabs feed no
+            # data). Summed across hosts this is the scales-with-host-
+            # count ingest number the shard-aware feed buys.
+            telemetry.count("multihost.shard_feed_bytes", slab.nbytes)
         gblock = jax.make_array_from_process_local_data(sharding, slab)
         return gblock, meta
 
@@ -214,12 +231,25 @@ def stream_global_blocks(
         gathered = gather_round(np.int64(local_steps))
         if (gathered >= 0).all():
             # Every process pre-counted: one agreed total, zero further
-            # control traffic.
+            # control traffic. Assembly runs ONE block ahead of the
+            # yield: block k+1's per-shard H2D transfer (the
+            # make_array placement) is dispatched while the consumer's
+            # update still runs on block k — the double-buffered feed
+            # that keeps the ring schedule's devices fed from the host
+            # side. Cursor/checkpoint semantics are untouched (the
+            # consumer sees the same blocks in the same order; only
+            # production runs ahead).
             produced = 0
+            pending = None
             for _ in range(int(gathered.max())):
                 item = next(it, None)
                 produced += item is not None
-                yield assemble(item)
+                assembled = assemble(item)
+                if pending is not None:
+                    yield pending
+                pending = assembled
+            if pending is not None:
+                yield pending
             # Contract watchdog: every process joins ONE final agreement
             # round on its own ok flag, so a broken exact_n_variants
             # claim aborts ALL processes within this consensus period —

@@ -45,14 +45,42 @@ from spark_examples_tpu.parallel.gram_sharded import GramPlan, _acc_shardings
 @lru_cache(maxsize=32)
 def _finalize_field_jit(plan: GramPlan, metric: str, field: str):
     """acc (tile2d leaves) -> one finalized matrix ("distance" for the
-    PCoA route, "similarity" for PCA), kept tile2d."""
+    PCoA route, "similarity" for PCA), kept tile2d.
+
+    Donation is restricted to leaves the executable can actually alias
+    into the f32 output tile: XLA input/output aliasing is by
+    (dtype, shape, layout), so donating the count family's int32 pieces
+    (or grm's scalar nvar) only earns the "Some donated buffers were
+    not usable" warning — every MULTICHIP dryrun printed it — without
+    freeing anything the post-call invalidation doesn't already free.
+    Only float-family N x N leaves (grm's zz) qualify; everything else
+    rides the non-donated argument and is dropped by the caller's
+    ``del`` as before. tests/test_parallel.py asserts the whole sharded
+    route now compiles warning-free."""
+    from spark_examples_tpu import kernels
+
+    kern = kernels.get(metric)
     acc_sh = _acc_shardings(plan, metric)
-    return jax.jit(
-        lambda acc: distances.finalize(acc, metric)[field],
-        in_shardings=(acc_sh,),
+    donatable = tuple(
+        k for k in kern.acc_leaves
+        if kern.family == "float" and k not in kern.scalar_leaves
+    )
+    rest = tuple(k for k in kern.acc_leaves if k not in donatable)
+    jitted = jax.jit(
+        lambda don, keep: distances.finalize({**don, **keep}, metric)[field],
+        in_shardings=(
+            {k: acc_sh[k] for k in donatable},
+            {k: acc_sh[k] for k in rest},
+        ),
         out_shardings=plan.acc_sharding,
         donate_argnums=(0,),
     )
+
+    def call(acc):
+        return jitted({k: acc[k] for k in donatable},
+                      {k: acc[k] for k in rest})
+
+    return call
 
 
 def _center_sym(s):
@@ -102,11 +130,14 @@ def _eigh_jit(plan: GramPlan, k: int, oversample: int, iters: int,
             return vals, vecs, jnp.trace(b)
         return vals, vecs
 
+    # No donation: every output is a small replicated (k,)/(N, k) block
+    # — a tiled N x N input can never alias one, so donating b only
+    # produced the unusable-donation warning. b is freed by the caller's
+    # scope exit exactly as before.
     return jax.jit(
         solve,
         in_shardings=(plan.acc_sharding, repl),
         out_shardings=(repl, repl, repl) if with_trace else (repl, repl),
-        donate_argnums=(0,),
     )
 
 
@@ -114,9 +145,10 @@ def _solve_sharded(plan, acc, metric, field, center_kind, k, key,
                    oversample, iters, select, with_trace,
                    check_shardings, timer):
     """Shared stage choreography of both sharded routes: finalize ->
-    center -> randomized eig, every N x N input donated stage to stage
-    (per-device peak ~one tile per live stage) and tile-asserted at each
-    boundary. The two public entry points differ only in parameters."""
+    center -> randomized eig, every alias-able N x N input donated
+    stage to stage and the rest dropped eagerly (per-device peak ~one
+    tile per live stage) and tile-asserted at each boundary. The two
+    public entry points differ only in parameters."""
     from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 
     if key is None:
@@ -209,9 +241,10 @@ def pcoa_coords_sharded(
     ``timer``: optional PhaseTimer recording finalize/eigh phases (adds
     a hard sync per phase boundary for honest wall-clock).
 
-    Every stage donates its N x N input (acc -> dist -> B -> eigh
-    scratch), so per-device peak stays ~one tile per live stage instead
-    of accumulating all of them; ``acc`` is consumed — callers must not
+    Every stage donates the N x N inputs its executable can alias
+    (dist -> B; grm's float acc -> dist) and drops the rest eagerly, so
+    per-device peak stays ~one tile per live stage instead of
+    accumulating all of them; ``acc`` is consumed — callers must not
     reuse it afterwards.
     """
     vals, vecs, trace = _solve_sharded(

@@ -301,8 +301,30 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
     packed = cfg.pack_stream == "packed" or (
         cfg.pack_stream == "auto" and kern.pack_auto
     )
+    # tile2d block reassembly: resolve "auto" HERE, where the job's
+    # block shape is known, so the ring/gather choice is one decision
+    # per plan (the kernel's FLOPs model against a shard hop —
+    # gram_sharded.resolve_transport) instead of per block; the
+    # ring-divisibility contract is checked at the same spot, with the
+    # flags named, before any tracing.
+    transport = cfg.tile2d_transport
+    if plan.mode == "tile2d" and plan.mesh.devices.size > 1:
+        if transport == "auto":
+            transport = gram_sharded.resolve_transport(
+                plan, metric, n, job.ingest.block_variants, packed)
+        if transport == "ring":
+            from spark_examples_tpu.ingest.prefetch import padded_width
+
+            gram_sharded.check_ring_divisible(
+                padded_width(job.ingest.block_variants,
+                             plan.block_shards, packed),
+                plan, packed,
+            )
+    else:
+        transport = "gather"
     update = gram_sharded.make_update(
-        plan, metric, packed=packed, grm_precise=cfg.grm_precise
+        plan, metric, packed=packed, grm_precise=cfg.grm_precise,
+        transport=transport,
     )
 
     bv = job.ingest.block_variants

@@ -214,3 +214,47 @@ def rayleigh_eigs(y: jnp.ndarray, q: jnp.ndarray, k: int,
     (``y = B q``, q orthonormal). Returns (vals (k,) descending,
     vecs (N, k))."""
     return _rayleigh_jit(plan, k)(y, q)
+
+
+def stage_runtimes(n: int, rank: int, plan: GramPlan | None = None,
+                   k: int = 10, repeats: int = 3,
+                   seed: int = 0) -> dict[str, float]:
+    """Measured wall-clock of the distributed solve stages at an
+    ``(n, rank)`` sketch shape on ``plan``'s mesh (best of ``repeats``
+    after a compile+warm run, per stage, in seconds):
+
+    - ``cholqr2_s`` — one shifted CholeskyQR2 orthonormalization (the
+      between-pass step: two local r x r grams + psums, two triangular
+      solves, two skinny matmuls over the row-sharded block);
+    - ``nystrom_s`` — the single-pass terminal Nystrom solve;
+    - ``rayleigh_s`` — the corrected rung's terminal Rayleigh solve.
+
+    This is the bench entry the multi-chip row uses (bench.py
+    --multichip) to measure the row-sharded stages at the N=100k
+    shapes ROADMAP item 4 names, on whatever mesh exists — the same
+    jits production solves run, not a proxy. Inputs are seeded normal
+    blocks: stage wall-clock is shape-, not spectrum-, dependent
+    (fixed operation count; the one data-dependent op is an r x r
+    eigh, microseconds at these ranks)."""
+    import time
+
+    from spark_examples_tpu.core.profiling import hard_sync
+
+    y = hard_sync(jax.random.normal(jax.random.key(seed), (n, rank),
+                                    jnp.float32))
+    qc = hard_sync(jax.random.normal(jax.random.key(seed + 1), (n, rank),
+                                     jnp.float32))
+    out: dict[str, float] = {}
+    for name, fn in (
+        ("cholqr2_s", lambda: orthonormalize(y, plan)),
+        ("nystrom_s", lambda: nystrom_eigs(y, qc, k, plan)),
+        ("rayleigh_s", lambda: rayleigh_eigs(y, qc, k, plan)),
+    ):
+        hard_sync(fn())  # compile + warm
+        best = float("inf")
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            hard_sync(fn())
+            best = min(best, time.perf_counter() - t0)
+        out[name] = best
+    return out

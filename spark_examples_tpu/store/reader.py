@@ -680,7 +680,10 @@ class StoreRangeSource:
         inner = [b for b in bounds if self.lo < b < self.hi]
         return not inner
 
-    def blocks(self, block_variants: int, start_variant: int = 0):
+    def _grid(self, block_variants: int):
+        """(idx, lo, hi, contig) over the window's own block grid
+        (GLOBAL lo/hi, never spanning a contig run) — shared by
+        :meth:`blocks` and :meth:`block_spans`."""
         bounds = self.store.manifest.segment_bounds()
         runs = self.store.manifest.contig_runs
         idx = 0
@@ -691,15 +694,44 @@ class StoreRangeSource:
                 continue
             for lo in range(seg_lo, seg_hi, block_variants):
                 hi = min(lo + block_variants, seg_hi)
-                local_lo = lo - self.lo
-                if local_lo < start_variant:
-                    idx += 1
-                    continue
-                covering = self.store.manifest.chunks_for_range(lo, hi)
-                if covering:
-                    self.store._schedule_ahead(covering[-1][0])
-                meta = self.store._meta(idx, lo, hi, runs[s][0])
-                yield self.store.read_range(lo, hi), _dc_replace(
-                    meta, start=local_lo, stop=hi - self.lo,
-                )
+                yield idx, lo, hi, runs[s][0]
                 idx += 1
+
+    def blocks(self, block_variants: int, start_variant: int = 0):
+        for lo, hi, meta in self.block_spans(block_variants, start_variant):
+            yield self.store.read_range(self.lo + lo, self.lo + hi), meta
+
+    def block_spans(self, block_variants: int, start_variant: int = 0):
+        """The column-window read path: (lo, hi, meta) in the window's
+        LOCAL coordinates, the decode-free twin of :meth:`blocks` that
+        lets a caller owning destination buffers (the prefetch staging
+        ring, the multi-host per-process feed) drive
+        :meth:`decode_range_into` itself — each process then decodes
+        only its own mesh shard's variant slice straight into its slab,
+        with no intermediate dense block and no post-decode slicing.
+        Same grid, same resume semantics, same readahead scheduling as
+        the full-store span path."""
+        for idx, lo, hi, contig in self._grid(block_variants):
+            local_lo = lo - self.lo
+            if local_lo < start_variant:
+                continue
+            covering = self.store.manifest.chunks_for_range(lo, hi)
+            if covering:
+                self.store._schedule_ahead(covering[-1][0])
+            meta = self.store._meta(idx, lo, hi, contig)
+            yield local_lo, hi - self.lo, _dc_replace(
+                meta, start=local_lo, stop=hi - self.lo,
+            )
+
+    def decode_range_into(self, lo: int, hi: int, out: np.ndarray,
+                          col_off: int = 0) -> None:
+        """Decode LOCAL window variants [lo, hi) into ``out`` — the
+        window offset applied, then straight through the store's native
+        decode-to-slab entry."""
+        if not 0 <= lo <= hi <= self.n_variants:
+            raise ValueError(
+                f"variant range [{lo}, {hi}) out of bounds for a "
+                f"{self.n_variants}-variant window"
+            )
+        self.store.decode_range_into(self.lo + lo, self.lo + hi, out,
+                                     col_off)

@@ -367,3 +367,204 @@ def test_replicated_block_layout_rejected_for_variant_mode(mesh):
     plan = gram_sharded.GramPlan(mesh, "variant")
     with pytest.raises(ValueError, match="redundantly"):
         gram_sharded.make_update(plan, "ibs", block_layout="replicated")
+
+
+# ------------------------------------------------------- ring transport
+
+
+@pytest.mark.parametrize("packed", [False, True])
+@pytest.mark.parametrize(
+    "metric", ["ibs", "ibs2", "king", "jaccard", "grm"]
+)
+def test_ring_transport_matches_gather(rng, mesh, metric, packed):
+    """The tentpole contract: the ppermute ring schedule produces the
+    SAME accumulators as the bulk all_gather — BIT-identical for every
+    int32-accumulating kernel (integer sums are exact under the ring's
+    per-shard reordering), allclose for grm's f32. Every device starts
+    at a different ring offset (device d contracts shards d, d+1, ...,
+    d-1 in that order), so one pass covers all 8 offsets; the final
+    ragged block additionally exercises the pad path on both
+    transports."""
+    from spark_examples_tpu.ingest import bitpack
+
+    g = random_genotypes(rng, n=32, v=288, missing_rate=0.12)
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    accs = {}
+    for transport in ("gather", "ring"):
+        acc = gram_sharded.init_sharded(plan, 32, metric)
+        update = gram_sharded.make_update(plan, metric, packed=packed,
+                                          transport=transport)
+        for s in range(0, 288, 96):  # final block ragged after padding
+            blk = g[:, s:s + 96]
+            if packed:
+                blk = bitpack.pack_dosages(blk)
+            acc = update(acc, blk)
+        accs[transport] = {k: np.asarray(v) for k, v in acc.items()}
+    for k in accs["gather"]:
+        if metric == "grm" and k == "zz":
+            np.testing.assert_allclose(
+                accs["gather"][k], accs["ring"][k], rtol=1e-5, atol=1e-4,
+                err_msg=f"{metric}/{k}")
+        else:
+            np.testing.assert_array_equal(
+                accs["gather"][k], accs["ring"][k],
+                err_msg=f"ring transport diverged from gather on "
+                        f"{metric}/{k} (packed={packed})")
+
+
+def test_ring_lowering_is_permute_only(mesh):
+    """Compile check of the overlapped schedule: the ring transport's
+    hot loop lowers to collective-permutes ONLY — no bulk all-gather
+    serializing in front of the contraction, and no partial-tile
+    all-reduce (the pathological SPMD lowering both explicit shard_maps
+    exist to prevent)."""
+    from spark_examples_tpu.parallel.gram_sharded import _jitted_update
+
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    n, v = 32, 64
+    acc_spec = {
+        k: jax.ShapeDtypeStruct((n, n), np.int32)
+        for k in gram.PIECES_FOR_METRIC["ibs"]
+    }
+    blk_spec = jax.ShapeDtypeStruct((n, v), np.int8)
+    jitted = _jitted_update(plan, "ibs", False, False, "sharded", "ring")
+    hlo = jitted.lower(acc_spec, blk_spec).compile().as_text()
+    assert "collective-permute" in hlo, (
+        "ring transport must move shards via collective-permute"
+    )
+    assert "all-gather" not in hlo and "all-reduce" not in hlo, (
+        "a bulk collective crept into the ring transport's hot loop"
+    )
+
+
+def test_transport_auto_resolution(mesh):
+    """The FLOPs-model choice: production shapes (76k x 4096 packed)
+    hide a shard hop behind one ring step's contraction -> ring; tiny
+    test tiles do not -> gather. Non-tile2d plans have no choice."""
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    assert gram_sharded.resolve_transport(
+        plan, "ibs", 76_000, 4096, True) == "ring"
+    assert gram_sharded.resolve_transport(
+        plan, "ibs", 32, 64, False) == "gather"
+    vplan = gram_sharded.GramPlan(mesh, "variant")
+    assert gram_sharded.resolve_transport(
+        vplan, "ibs", 76_000, 4096, True) == "gather"
+
+
+def test_ring_divisibility_validated_with_flags_named(mesh):
+    """The satellite contract: a block width the shard count cannot
+    divide dies with --tile2d-transport/--block-variants named, not as
+    a raw shard_map sharding error; and the config-time flag value
+    check names the flag too."""
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    with pytest.raises(ValueError, match=r"--tile2d-transport ring"):
+        gram_sharded.check_ring_divisible(60, plan, packed=False)
+    with pytest.raises(ValueError, match=r"--block-variants"):
+        gram_sharded.check_ring_divisible(7, plan, packed=True)
+    # divisible widths (what the padded feeds produce) pass silently
+    gram_sharded.check_ring_divisible(64, plan, packed=False)
+
+    from spark_examples_tpu.core.config import ComputeConfig
+
+    with pytest.raises(ValueError, match=r"--tile2d-transport"):
+        ComputeConfig(tile2d_transport="mesh")
+
+
+def test_ring_run_gram_checkpoint_resumes_bit_identical(rng, tmp_path):
+    """Kill/resume row for ring mode: a ring-transport streamed job
+    killed mid-stream resumes from its checkpoint to the SAME
+    similarity as the uninterrupted ring run — and both match the
+    gather transport bit-exactly (the checkpointed accumulator is
+    transport-agnostic by the bit-identity contract)."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest import ArraySource
+    from spark_examples_tpu.pipelines import runner
+
+    g = random_genotypes(rng, n=16, v=1024, missing_rate=0.1)
+
+    def job(transport, ckpt=None):
+        return JobConfig(
+            ingest=IngestConfig(block_variants=128),
+            compute=ComputeConfig(
+                metric="ibs", gram_mode="tile2d",
+                tile2d_transport=transport,
+                checkpoint_dir=ckpt,
+                checkpoint_every_blocks=2 if ckpt else 0,
+            ),
+        )
+
+    class Dying(ArraySource):
+        def blocks(self, bv, start_variant=0):
+            for b, m in super().blocks(bv, start_variant):
+                if m.start >= 5 * 128:
+                    raise RuntimeError("simulated preemption")
+                yield b, m
+
+    ckpt = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError, match="preemption"):
+        runner.run_similarity(job("ring", ckpt), source=Dying(g))
+    import os
+
+    assert os.path.isdir(ckpt)  # a mid-stream checkpoint exists
+    resumed = runner.run_similarity(job("ring", ckpt), source=ArraySource(g))
+    clean_ring = runner.run_similarity(job("ring"), source=ArraySource(g))
+    clean_gather = runner.run_similarity(job("gather"), source=ArraySource(g))
+    np.testing.assert_array_equal(resumed.similarity,
+                                  clean_ring.similarity)
+    np.testing.assert_array_equal(resumed.similarity,
+                                  clean_gather.similarity)
+
+
+def test_ring_update_counts_ring_steps(rng, mesh):
+    from spark_examples_tpu.core import telemetry
+
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    before = telemetry.counter_value("gram.ring_steps")
+    update = gram_sharded.make_update(plan, "ibs", transport="ring")
+    acc = gram_sharded.init_sharded(plan, 32, "ibs")
+    update(acc, random_genotypes(rng, n=32, v=64, missing_rate=0.1))
+    assert telemetry.counter_value("gram.ring_steps") - before == 8
+
+
+def test_sharded_route_emits_no_unusable_donation_warnings(rng, mesh):
+    """The MULTICHIP_r05 satellite: every jit of the tile2d update AND
+    the sharded finalize/center/eigh route must donate only buffers the
+    executable can actually alias — 'Some donated buffers were not
+    usable' in the dryrun tail meant int32 accumulators (and grm's
+    scalar) were being donated into f32/replicated outputs for no
+    gain. Caches are cleared so lowering (where the warning fires)
+    happens inside the catch for every stage."""
+    import warnings
+
+    from spark_examples_tpu.parallel import pcoa_sharded
+    from spark_examples_tpu.parallel.gram_sharded import _jitted_update
+
+    _jitted_update.cache_clear()
+    pcoa_sharded._finalize_field_jit.cache_clear()
+    pcoa_sharded._center_jit.cache_clear()
+    pcoa_sharded._eigh_jit.cache_clear()
+
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for metric in ("ibs", "grm"):
+            acc = gram_sharded.init_sharded(plan, 32, metric)
+            for transport in ("gather", "ring"):
+                update = gram_sharded.make_update(plan, metric,
+                                                  transport=transport)
+                acc = update(acc, random_genotypes(rng, 32, 64, 0.1))
+            res = pcoa_sharded.pcoa_coords_sharded(plan, acc, metric, k=3)
+            jax.block_until_ready(res.coords)
+        acc = gram_sharded.init_sharded(plan, 32, "shared-alt")
+        update = gram_sharded.make_update(plan, "shared-alt")
+        acc = update(acc, random_genotypes(rng, 32, 64, 0.1))
+        res = pcoa_sharded.pca_coords_sharded(plan, acc, "shared-alt", k=3)
+        jax.block_until_ready(res.coords)
+    bad = [str(w.message) for w in caught
+           if "donated buffers" in str(w.message)]
+    assert not bad, (
+        "sharded route emitted unusable-donation warnings:\n"
+        + "\n".join(bad)
+    )

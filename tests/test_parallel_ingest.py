@@ -515,3 +515,99 @@ def test_compact_rejects_nonpositive_workers(tmp_path, genotypes):
     with pytest.raises(ValueError, match="workers"):
         compact(str(tmp_path / "s"), ArraySource(genotypes),
                 chunk_variants=32, workers=0)
+
+
+# ---------------------------------------------------------------------------
+# Shard-aware feed (the multi-chip PR): column-window spans + decode-
+# direct host blocks + the multi-host feeder's double-buffered assembly.
+
+
+def test_store_range_source_spans_match_blocks(tmp_path, genotypes):
+    """StoreRangeSource's column-window read path: block_spans +
+    decode_range_into (local coordinates) reproduce blocks() bit-
+    identically — the contract the multi-host per-process feed drives."""
+    d = str(tmp_path / "s")
+    compact(d, ArraySource(genotypes), chunk_variants=32)
+    store = open_store(d)
+    rng_src = store.variant_range(48, 176)  # chunk-misaligned bounds
+    assert hasattr(rng_src, "block_spans")
+    spans = list(rng_src.block_spans(40))
+    via_blocks = list(rng_src.blocks(40))
+    assert len(spans) == len(via_blocks)
+    for (lo, hi, meta), (blk, bmeta) in zip(spans, via_blocks):
+        assert (meta.start, meta.stop) == (bmeta.start, bmeta.stop)
+        out = np.full((store.n_samples, hi - lo), -9, np.int8)
+        rng_src.decode_range_into(lo, hi, out)
+        np.testing.assert_array_equal(out, blk)
+    with pytest.raises(ValueError, match="out of bounds"):
+        rng_src.decode_range_into(0, 1000, np.empty((store.n_samples, 1000), np.int8))
+
+
+def test_window_over_retrying_store_forwards_decode_direct(tmp_path, genotypes):
+    """The multi-host partition chain — WindowSource over RetryingSource
+    over StoreSource — keeps the decode-straight-into-buffer capability
+    end to end, and stream_host_blocks' direct drive yields blocks
+    bit-identical to the ordinary path (same metas, same padding)."""
+    from spark_examples_tpu.ingest.prefetch import (
+        pad_block, stream_host_blocks,
+    )
+    from spark_examples_tpu.ingest.source import WindowSource
+
+    d = str(tmp_path / "s")
+    compact(d, ArraySource(genotypes), chunk_variants=32)
+
+    def _open():
+        return open_store(d)
+
+    retrying = RetryingSource(_open(), policy=RetryPolicy(max_retries=2),
+                              reopen=_open)
+    win = WindowSource(retrying, 48, 200)
+    assert hasattr(win, "block_spans") and hasattr(win, "decode_range_into")
+    got = list(stream_host_blocks(win, 48))  # direct decode drive
+    want = [
+        (pad_block(b, 48), m)
+        for b, m in WindowSource(_open(), 48, 200).blocks(48)
+    ]
+    assert len(got) == len(want)
+    for (gb, gm), (wb, wm) in zip(got, want):
+        np.testing.assert_array_equal(gb, wb)
+        assert (gm.start, gm.stop) == (wm.start, wm.stop)
+    # a window over a capability-less source does NOT advertise the path
+    plain = WindowSource(ArraySource(genotypes), 48, 200)
+    assert not hasattr(plain, "block_spans")
+    # the window's decode is bounds-checked against the WINDOW: an
+    # over-long span must error, never silently decode a neighboring
+    # partition's variants (double-counting in a multi-host job)
+    with pytest.raises(ValueError, match="out of bounds"):
+        win.decode_range_into(
+            0, win.n_variants + 8,
+            np.empty((win.n_samples, win.n_variants + 8), np.int8))
+
+
+def test_stream_global_blocks_double_buffer_and_feed_bytes(genotypes):
+    """Single-process run of the multi-host feeder: the one-block-ahead
+    assembly pipeline must preserve block order/content and count
+    multihost.shard_feed_bytes for exactly the real (non-padding)
+    slabs this process fed."""
+    import jax
+
+    from spark_examples_tpu.core import telemetry
+    from spark_examples_tpu.parallel import gram_sharded, multihost as mh
+    from spark_examples_tpu.core import meshes
+
+    mesh = meshes.make_mesh()
+    plan = gram_sharded.GramPlan(mesh, "variant")
+    src = ArraySource(genotypes)  # 37 x 211
+    before = telemetry.counter_value("multihost.shard_feed_bytes")
+    got = list(mh.stream_global_blocks(src, 64, 0, plan, pack=False))
+    fed = telemetry.counter_value("multihost.shard_feed_bytes") - before
+    # ceil(211/64) = 4 blocks, each padded to a multiple of 8 devices
+    assert len(got) == 4
+    w = 64  # 64 % 8 == 0 -> padded width = block width
+    assert fed == 4 * genotypes.shape[0] * w
+    whole = np.concatenate(
+        [np.asarray(g)[:, :m.stop - m.start] for g, m in got], axis=1
+    )
+    np.testing.assert_array_equal(whole, genotypes)
+    for g, _m in got:
+        assert isinstance(g, jax.Array) and g.sharding == plan.block_sharding

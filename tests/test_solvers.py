@@ -257,3 +257,20 @@ def test_euclidean_sketch_no_missing():
         sketch_iters=3)), source=src())
     rel = _relerr(got.eigenvalues, np.asarray(exact.eigenvalues))
     assert rel[:3].max() < 1e-2, rel
+
+
+def test_stage_runtimes_measures_all_stages():
+    """The multi-chip bench's solve-stage entry (solvers/solve.
+    stage_runtimes): every row-sharded stage is measured, positive, and
+    runs on both a mesh plan and the single-device (None) plan — the
+    same jits production solves use, so a measured row here is the real
+    path, not a proxy."""
+    from spark_examples_tpu.core import meshes
+    from spark_examples_tpu.parallel.gram_sharded import GramPlan
+    from spark_examples_tpu.solvers.solve import stage_runtimes
+
+    plan = GramPlan(meshes.make_mesh(), "tile2d")
+    for p in (None, plan):
+        times = stage_runtimes(256, 16, p, k=4, repeats=1)
+        assert set(times) == {"cholqr2_s", "nystrom_s", "rayleigh_s"}
+        assert all(v > 0 for v in times.values()), times

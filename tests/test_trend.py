@@ -51,6 +51,15 @@ def test_metric_directions_resolve_sensibly():
     assert d("kernel_king_gflops") == trend.HIGHER_IS_BETTER
     assert d("kernel_sweep_min_gflops") == trend.HIGHER_IS_BETTER
     assert d("kernel_sweep_ok") == trend.BOOL_MUST_HOLD
+    # Multi-chip row (bench --multichip): throughput, the d8-vs-d1
+    # wall-clock scaling, and the gather-hidden-behind-compute fraction
+    # all go up; the solve-stage seconds go down; the ring-identity +
+    # scaling gate holds.
+    assert d("multichip_gram_mb_s") == trend.HIGHER_IS_BETTER
+    assert d("multichip_scaling_d8_vs_d1") == trend.HIGHER_IS_BETTER
+    assert d("multichip_overlap_frac") == trend.HIGHER_IS_BETTER
+    assert d("multichip_solve_n100k_s") == trend.LOWER_IS_BETTER
+    assert d("multichip_ok") == trend.BOOL_MUST_HOLD
 
 
 # ------------------------------------------------------------------ the band

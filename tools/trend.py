@@ -92,6 +92,11 @@ _EXPLICIT: dict[str, int | None] = {
 _RULES: tuple[tuple[str, str, int], ...] = (
     ("contains", "relerr", LOWER_IS_BETTER),
     ("contains", "stall_frac", LOWER_IS_BETTER),
+    # 1 - gather_wait/compute of the measured multi-chip gram (bench
+    # --multichip): more of the block collective hidden behind the MXU
+    # is strictly better — and it must outrank the generic "_frac"-less
+    # suffix rules below (multichip_overlap_frac has no other token).
+    ("contains", "overlap_frac", HIGHER_IS_BETTER),
     ("contains", "compress_ratio", HIGHER_IS_BETTER),
     ("contains", "_mb_s", HIGHER_IS_BETTER),
     ("contains", "qps", HIGHER_IS_BETTER),
