@@ -1951,6 +1951,7 @@ def main() -> None:
         per = configs["kernels"]["per_kernel"]
         # The two kernels the registry PR ships/highlights ride the
         # headline by name; the rest gate through the sweep floor.
+        # graftlint: disable=registry-literal  # a deliberate highlight pair (the kernels the registry PR shipped and measured), not an enumeration — every other kernel gates through the sweep floor below
         for kname in ("jaccard", "king"):
             headline[f"kernel_{kname}_mb_s"] = per[kname]["mb_s"]
             headline[f"kernel_{kname}_gflops"] = per[kname]["gflops"]
@@ -1962,6 +1963,22 @@ def main() -> None:
             and all(r["gflops"] > 0 and r["mb_s"] > 0
                     for r in per.values())
         )
+
+    # Static-analysis gate: the graftlint invariant suite over the
+    # production tree rides every bench headline (lint_ok must HOLD
+    # under the trend gate — a new finding is a regression even when
+    # every perf number improved).
+    try:
+        from tools import graftlint
+
+        lint_findings = graftlint.run()
+        headline["lint_findings"] = len(lint_findings)
+        headline["lint_ok"] = not lint_findings
+        for f in lint_findings[:5]:
+            log(f"graftlint: {f.render()}")
+    except Exception as e:
+        log(f"graftlint FAILED: {e!r}")
+        headline["lint_ok"] = False
 
     # Noise-aware trend gate (tools/trend.py): the candidate headline
     # vs the trailing BENCH_HISTORY.jsonl window. Checked BEFORE the

@@ -141,7 +141,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "does not (live depth = the store.readahead.depth "
                    "gauge; 0 pins the depth at the floor)")
     g.add_argument("--store-codec", default="zlib",
-                   metavar="{" + ",".join(config.STORE_CODEC_SPECS) + "}",
+                   choices=list(config.STORE_CODEC_SPECS),
                    help="chunk payload codec for `ingest` compactions: "
                    "raw = uncompressed 2-bit payload, zlib = per-chunk "
                    "deflate (deterministic, ~several-fold smaller on "
@@ -176,8 +176,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "50 block-periods of the job's own reported block "
                    "p95")
     c = p.add_argument_group("compute")
+    # Enum choices come from the config-time validators (core/config
+    # enum tuples) — one source of truth, so argparse and validation
+    # can never drift (graftlint: registry-literal).
     c.add_argument("--backend", default="jax-tpu",
-                   choices=["jax-tpu", "cpu-reference"])
+                   choices=list(config.BACKENDS))
     # Choices come from the kernel registry (jax-free import) — adding
     # a kernel registration makes it CLI-reachable with no edit here.
     c.add_argument("--metric", default="ibs",
@@ -186,9 +189,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     c.add_argument("--mesh-shape", default=None,
                    help="IxJ, e.g. 2x4 (default: auto-factor devices)")
     c.add_argument("--gram-mode", default="auto",
-                   choices=["auto", "replicated", "variant", "tile2d"])
+                   choices=list(config.GRAM_MODES))
     c.add_argument("--tile2d-transport", default="auto",
-                   choices=["auto", "gather", "ring"],
+                   choices=list(config.TILE2D_TRANSPORTS),
                    help="tile2d block reassembly over ICI: 'gather' = "
                    "one bulk all_gather serially before each "
                    "contraction; 'ring' = ppermute ring schedule "
@@ -198,7 +201,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "the contraction outweighs the hop (see README "
                    "'Multi-chip execution')")
     c.add_argument("--eigh-mode", default="auto",
-                   choices=["auto", "dense", "randomized"])
+                   choices=list(config.EIGH_MODES))
     c.add_argument("--eigh-iters", type=int,
                    default=config.EIGH_ITERS_DEFAULT,
                    help="randomized solver power iterations (default "
@@ -234,7 +237,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "must keep it (the checkpoint records it and "
                    "rejects a mismatch)")
     c.add_argument("--braycurtis-method", default="auto",
-                   choices=["auto", "exact", "matmul", "pallas"],
+                   choices=list(config.BRAYCURTIS_METHODS),
                    help="braycurtis lowering: auto (pallas on an "
                    "accelerator, exact on CPU), elementwise VPU path, "
                    "threshold-decomposed MXU matmuls (quantised), or the "
@@ -550,6 +553,22 @@ def main(argv: list[str] | None = None) -> int:
                        help="stitched trace path (default: "
                        "<path>/stitched_trace.jsonl)")
 
+    p_lint = sub.add_parser(
+        "lint",
+        help="run graftlint, the AST-based invariant analyzer suite "
+        "distilled from this repo's bug history (registry-literal "
+        "drift, donation safety, blocking-under-lock, atomic-write "
+        "discipline, jax-import purity, telemetry/fault-site names, "
+        "thread hygiene) — exit 1 on findings; see README 'Static "
+        "analysis'",
+    )
+    p_lint.add_argument("paths", nargs="*",
+                        help="files/dirs (default: the production tree)")
+    p_lint.add_argument("--rules", default=None, metavar="ID[,ID...]")
+    p_lint.add_argument("--format", default="text",
+                        choices=["text", "json"])
+    p_lint.add_argument("--list-rules", action="store_true")
+
     p_cov = sub.add_parser("coverage",
                            help="per-base read coverage over ranges "
                            "(the SearchReads example tier)")
@@ -566,6 +585,19 @@ def main(argv: list[str] | None = None) -> int:
 
     args = parser.parse_args(argv)
 
+    if args.command == "lint":
+        # Thin wrapper over tools.graftlint — dispatched BEFORE any jax
+        # import (the suite is contractually device-free, like the
+        # supervised parent it lints).
+        argv_lint = list(args.paths)
+        if args.rules:
+            argv_lint += ["--rules", args.rules]
+        if args.list_rules:
+            argv_lint += ["--list-rules"]
+        argv_lint += ["--format", args.format]
+        from tools.graftlint.__main__ import main as graftlint_main
+
+        return graftlint_main(argv_lint)
     if args.command == "coverage":
         return _run_coverage(args)
     if args.command == "store":

@@ -1,1 +1,25 @@
-from spark_examples_tpu.core import config, dtypes, meshes, profiling  # noqa: F401
+"""Core subpackage.
+
+Submodules are resolved lazily (PEP 562): ``core.dtypes`` /
+``core.meshes`` / ``core.profiling`` import jax at module level, and an
+eager re-export here would put a jax runtime (and on TPU, the chip
+lock) into every process that touches ANY core module — including the
+supervised CLI parent, config-time validation, and graftlint, which are
+all contractually device-free (graftlint: jax-import-purity; the eager
+form was found by that rule's first run over the tree)."""
+
+import importlib
+
+_SUBMODULES = ("checkpoint", "config", "dtypes", "faults", "hashing",
+               "live", "meshes", "profiling", "sidecar", "stitch",
+               "supervisor", "telemetry", "virtual")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_SUBMODULES))

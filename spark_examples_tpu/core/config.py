@@ -27,6 +27,21 @@ from spark_examples_tpu import kernels
 # and the codec registry can never drift apart.
 STORE_CODEC_SPECS = ("raw", "zlib", "zlib-dict")
 
+# Compute-path enum families, declared ONCE here so config-time
+# validation, the CLI's argparse choices, and the graftlint
+# registry-literal rule all read the same tuples — a literal re-listing
+# anywhere else goes stale the day a member is added (the PR 11
+# unreachable-Jaccard failure mode, generalized).
+BACKENDS = ("jax-tpu", "cpu-reference")
+# The resolved per-plan modes (what parallel/gram_sharded executes);
+# the config flag additionally accepts "auto" (resolved by plan_for).
+GRAM_PLAN_MODES = ("replicated", "variant", "tile2d")
+GRAM_MODES = ("auto",) + GRAM_PLAN_MODES
+TILE2D_TRANSPORTS = ("auto", "gather", "ring")
+EIGH_MODES = ("auto", "dense", "randomized")
+BRAYCURTIS_METHODS = ("auto", "exact", "matmul", "pallas")
+PACK_STREAMS = ("auto", "packed", "dense")
+
 # Single source of truth for the randomized-eigh accuracy-contract
 # defaults (BASELINE.md "Randomized-solver accuracy"): the CLI flags,
 # ComputeConfig, and the library-level solver defaults (ops/eigh.py,
@@ -338,16 +353,36 @@ class ComputeConfig:
                     f"integer in [{lo}, {hi}] ({why})"
                 )
 
-        if self.tile2d_transport not in ("auto", "gather", "ring"):
-            raise ValueError(
-                f"bad compute config: --tile2d-transport="
-                f"{self.tile2d_transport!r} — expected auto | gather | "
-                "ring (gather = bulk all_gather before each contraction; "
-                "ring = ppermute schedule overlapping each shard hop "
-                "with the previous shard's contraction; auto = ring when "
-                "the kernel's FLOPs model says the contraction hides "
-                "the hop)"
-            )
+        def _check_enum(flag, value, members, why):
+            if value not in members:
+                raise ValueError(
+                    f"bad compute config: {flag}={value!r} — expected "
+                    f"one of {' | '.join(members)} ({why})"
+                )
+
+        _check_enum("--backend", self.backend, BACKENDS,
+                    "jax-tpu = the accelerator path, cpu-reference = "
+                    "the NumPy/SciPy oracle")
+        _check_enum("--gram-mode", self.gram_mode, GRAM_MODES,
+                    "gram accumulation plan; auto picks from the mesh "
+                    "and accumulator size")
+        _check_enum("--eigh-mode", self.eigh_mode, EIGH_MODES,
+                    "dense eigh vs randomized subspace solver; auto "
+                    "picks by shape")
+        _check_enum("--braycurtis-method", self.braycurtis_method,
+                    BRAYCURTIS_METHODS,
+                    "braycurtis lowering; auto = pallas on an "
+                    "accelerator, exact on CPU")
+        _check_enum("pack_stream", self.pack_stream, PACK_STREAMS,
+                    "host->device block transport; auto packs "
+                    "dosage-defined metrics")
+        _check_enum("--tile2d-transport", self.tile2d_transport,
+                    TILE2D_TRANSPORTS,
+                    "gather = bulk all_gather before each contraction; "
+                    "ring = ppermute schedule overlapping each shard hop "
+                    "with the previous shard's contraction; auto = ring "
+                    "when the kernel's FLOPs model says the contraction "
+                    "hides the hop")
         _check("--sketch-rank", self.sketch_rank, 1, 65536,
                "range-sketch probe columns; clamped to N at run time")
         _check("--sketch-iters", self.sketch_iters, 0, 1000,

@@ -127,6 +127,7 @@ class PartitionedSource:
                 t = threading.Thread(
                     target=read_part,
                     args=(k, local_start if k == first_part else 0),
+                    name=f"partitioned-reader-{k}",
                     daemon=True,
                 )
                 threads.append(t)

@@ -474,7 +474,8 @@ def _produce_host_blocks(
         except BaseException as e:  # propagate into consumer
             _put(e, measure=False)
 
-    t = threading.Thread(target=produce, daemon=True)
+    t = threading.Thread(target=produce, name="prefetch-producer",
+                         daemon=True)
     t.start()
     try:
         while True:

@@ -74,6 +74,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_examples_tpu import kernels
 from spark_examples_tpu.core import meshes, telemetry
+from spark_examples_tpu.core.config import (
+    GRAM_PLAN_MODES,
+    TILE2D_TRANSPORTS,
+)
 from spark_examples_tpu.ops import gram as gram_ops
 
 # Rough per-chip HBM budget for resident accumulators (bytes).
@@ -105,7 +109,7 @@ class GramPlan:
         # Blocks already resident on-device take the "replicated" layout
         # instead (make_update(block_layout="replicated")) and skip the
         # gather entirely.
-        if self.mode in ("variant", "tile2d"):
+        if self.mode != "replicated":  # variant and tile2d both shard
             return meshes.variants_flat(self.mesh)
         return meshes.replicated(self.mesh)
 
@@ -160,7 +164,7 @@ def plan_for(
             mode = "variant"
         else:
             mode = "tile2d"
-    if mode not in ("replicated", "variant", "tile2d"):
+    if mode not in GRAM_PLAN_MODES:
         raise ValueError(f"unknown gram mode {mode!r}")
     if mode == "tile2d":
         check_tile_divisible(n_samples, mesh)
@@ -366,8 +370,6 @@ def _jitted_update(plan: GramPlan, metric: str, packed: bool,
 # value only moves the crossover shape, and both transports are always
 # forcible (--tile2d-transport gather|ring).
 RING_FLOP_PER_BYTE = 512.0
-
-TILE2D_TRANSPORTS = ("gather", "ring", "auto")
 
 
 def resolve_transport(plan: GramPlan, metric: str, n_samples: int,

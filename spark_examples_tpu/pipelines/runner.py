@@ -28,7 +28,12 @@ import numpy as np
 from spark_examples_tpu import kernels
 from spark_examples_tpu.core import checkpoint as ckpt
 from spark_examples_tpu.core import meshes, telemetry
-from spark_examples_tpu.core.config import IngestConfig, JobConfig
+from spark_examples_tpu.core.config import (
+    BRAYCURTIS_METHODS,
+    PACK_STREAMS,
+    IngestConfig,
+    JobConfig,
+)
 from spark_examples_tpu.core.profiling import PhaseTimer, hard_sync
 from spark_examples_tpu.ingest import (
     PlinkSource,
@@ -292,7 +297,7 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
     metric = cfg.metric or "ibs"
     if plan is None:
         plan = plan_for_job(job, source)
-    if cfg.pack_stream not in ("auto", "packed", "dense"):
+    if cfg.pack_stream not in PACK_STREAMS:
         raise ValueError(f"unknown pack_stream {cfg.pack_stream!r}")
     # auto: pack only kernels declaring pack_auto (inputs are dosages
     # by definition) — dot/euclidean accept arbitrary int8 tables the
@@ -622,10 +627,10 @@ def _run_braycurtis(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResu
         x = _materialize(source, job.ingest.block_variants)
         x = np.maximum(x, 0)  # missing (-1) counts as absence
     method = job.compute.braycurtis_method
-    if method not in ("auto", "exact", "matmul", "pallas"):
+    if method not in BRAYCURTIS_METHODS:
         raise ValueError(
             f"unknown braycurtis_method {method!r}; "
-            "valid: auto | exact | matmul | pallas"
+            f"valid: {' | '.join(BRAYCURTIS_METHODS)}"
         )
     if method == "auto":
         # Pallas is both the fastest and an exact lowering on real TPU

@@ -1,0 +1,14 @@
+"""Distilled PR 8 regression: in-place writes to durable artifacts —
+a kill mid-write leaves a torn metrics.json / manifest."""
+import json
+import pathlib
+
+
+def export(metrics_path, payload):
+    with open(metrics_path, "w") as f:  # line 8: raw write, durable path
+        json.dump(payload, f)
+
+
+def save(root, doc):
+    manifest = pathlib.Path(root) / "manifest.json"
+    manifest.write_text(json.dumps(doc))  # line 14: same class

@@ -1,0 +1,16 @@
+"""The fixed shape: stage to a tmp sibling, publish with os.replace."""
+import json
+import os
+
+
+def export(metrics_path, payload):
+    tmp = metrics_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, metrics_path)  # atomic publish
+
+
+def ordinary_output(path, rows):
+    # Not a durable artifact: plain result tables may write in place.
+    with open(path, "w") as f:
+        f.writelines(rows)

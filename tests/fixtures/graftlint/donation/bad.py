@@ -1,0 +1,25 @@
+"""Distilled PR 12 regression: donating int32/scalar leaves XLA cannot
+alias into float outputs, and reading a donated buffer after the call."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def finalize(pieces, nvar):
+    return pieces / nvar
+
+
+def unusable_donation(block):
+    pieces = jnp.zeros((8, 8), dtype=jnp.int32)
+    return finalize(pieces, 3)  # line 15: int32 arg 0, scalar arg 1
+
+
+_update = jax.jit(lambda acc, b: acc + b, donate_argnums=(0,))
+
+
+def read_after_donate(blocks):
+    acc = jnp.zeros((8, 8), dtype=jnp.float32)
+    out = _update(acc, blocks[0])
+    return out + acc.sum()  # line 24: acc was donated at line 23

@@ -1,0 +1,17 @@
+"""The safe shapes: float leaves, loop-carried rebinding."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(acc, b):
+    return acc + b
+
+
+def run(blocks):
+    acc = jnp.zeros((8, 8), dtype=jnp.float32)
+    for b in blocks:
+        acc = update(acc, b)  # rebinds: the old buffer is unreachable
+    return acc  # reads the LAST result, never a donated buffer

@@ -1,0 +1,7 @@
+"""Distilled PR 6 contract break: a module on the supervised parent's
+import path pulling jax in at module level (directly AND transitively
+through a package whose __init__ re-exports a jax-importing module)."""
+# graftlint: module=spark_examples_tpu.core.faults
+import jax  # line 5: direct
+
+from spark_examples_tpu.ops import gram  # line 7: transitive via ops

@@ -1,0 +1,13 @@
+"""The fixed shape: jax imports are lazy, contract imports stay in the
+jax-free closure."""
+# graftlint: module=spark_examples_tpu.core.faults
+import os
+import time
+
+from spark_examples_tpu.core import telemetry  # jax-free by contract
+
+
+def run_on_device(x):
+    import jax  # lazy: only the process that computes pays for it
+
+    return jax.device_put(x), os.getpid(), time.time()

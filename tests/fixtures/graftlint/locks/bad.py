@@ -1,0 +1,22 @@
+"""Distilled PR 6 regression: the SIGTERM drain flushed telemetry
+(file I/O) while holding the module lock the flush itself needed."""
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def flush(path, snapshot):
+    with _lock:
+        time.sleep(0.1)  # line 12: sleep under the lock
+        with open(path, "w") as f:  # line 13: file I/O under the lock
+            f.write(snapshot)
+
+
+def probe(lock, cmd):
+    lock.acquire()
+    try:
+        subprocess.run(cmd)  # line 20: subprocess inside acquire/release
+    finally:
+        lock.release()

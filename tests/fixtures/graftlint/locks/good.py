@@ -1,0 +1,16 @@
+"""The fixed shape: snapshot under the lock, I/O after releasing."""
+import threading
+
+_lock = threading.Lock()
+
+
+def flush(path, registry):
+    with _lock:
+        snapshot = dict(registry)  # cheap copy in the critical section
+    with open(path, "w") as f:  # I/O with no lock held
+        f.write(str(snapshot))
+
+
+def helper_call_is_not_lexical(path, registry, writer):
+    with _lock:
+        writer(path, registry)  # the callee's own lock use is its problem

@@ -1,0 +1,18 @@
+"""Distilled PRs 2/4 contract breaks the regex lints missed: an
+undeclared name behind an import ALIAS, an undeclared name built by
+CONCATENATION, an f-string name, and an undeclared fault site in a
+MULTI-LINE call."""
+from spark_examples_tpu.core import faults
+from spark_examples_tpu.core import telemetry as t
+
+_PREFIX = "serve."
+
+
+def handle(request, shard):
+    t.count("serve.bogus_requests", 1)  # line 12: undeclared, aliased
+    t.count(_PREFIX + "also_bogus", 1)  # line 13: undeclared, concat
+    t.observe(f"serve.latency_{shard}", 0.1)  # line 14: f-string name
+    faults.fire(  # multi-line call: the site literal is on line 16
+        "serve.bogus_site",
+        kind="io_error",
+    )

@@ -1,0 +1,18 @@
+"""Declared names through every shape the AST rules see: alias,
+concatenation of declared parts, multi-line call, dynamic-but-variable
+name (the runtime registry check's job, not lint's)."""
+from spark_examples_tpu.core import faults
+from spark_examples_tpu.core import telemetry as t
+
+_STORE = "store."
+
+
+def handle(request, name):
+    t.count("serve.requests", 1)
+    t.count(_STORE + "healed", 1)  # folds to the declared store.healed
+    t.observe(  # multi-line literal call site
+        "serve.latency_s",
+        0.1,
+    )
+    t.count(name, 1)  # dynamic variable: runtime-checked, not flagged
+    faults.fire("serve.request", kind="io_error")

@@ -1,0 +1,14 @@
+"""Distilled PR 11 regression: the CLI's hard-coded --metric choices
+list that made the freshly registered Jaccard kernel unreachable."""
+import argparse
+
+parser = argparse.ArgumentParser()
+parser.add_argument(
+    "--metric",
+    default="ibs",
+    choices=["ibs", "ibs2", "grm", "shared-alt"],  # line 9: the drift
+)
+parser.add_argument(
+    "--solver",
+    choices=("sketch", "corrected", "exact"),  # line 13: config enum too
+)

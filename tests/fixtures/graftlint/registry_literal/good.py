@@ -1,0 +1,13 @@
+"""The fixed shape: choices derived from the live registries."""
+import argparse
+
+from spark_examples_tpu import kernels
+from spark_examples_tpu.core import config
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--metric", default="ibs",
+                    choices=list(kernels.names()))
+parser.add_argument("--solver", choices=list(config.SOLVER_LADDER))
+# A mixed collection that merely CONTAINS one registry value is not an
+# enum listing.
+MODES = ["ibs", "something-else"]

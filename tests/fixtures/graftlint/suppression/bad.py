@@ -1,0 +1,12 @@
+"""A suppression that names no reason: the finding it silences is
+silenced, but the bare disable is itself a finding — an exception
+nobody can re-evaluate is a latent bug with a comment."""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def hold():
+    with _lock:
+        time.sleep(0.1)  # graftlint: disable=blocking-under-lock

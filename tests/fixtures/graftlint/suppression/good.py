@@ -1,0 +1,13 @@
+"""Reasoned suppressions: inline on the finding's line, or standing
+alone on the line directly above it."""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def hold():
+    with _lock:
+        time.sleep(0.01)  # graftlint: disable=blocking-under-lock  # test pacing stub: the sleep IS the critical section under test
+        # graftlint: disable=blocking-under-lock  # ditto, standalone form
+        time.sleep(0.01)

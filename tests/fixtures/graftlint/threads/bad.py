@@ -1,0 +1,12 @@
+"""Distilled PR 6 contract breaks: threads the soak leak accounting
+cannot see — anonymous, implicit-daemon, or prefix-uncovered."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def start(work):
+    t1 = threading.Thread(target=work)  # line 8: no daemon, no name
+    t2 = threading.Thread(  # line 9: uncovered prefix
+        target=work, name="mystery-worker", daemon=True)
+    pool = ThreadPoolExecutor(max_workers=2)  # line 11: anonymous pool
+    return t1, t2, pool

@@ -1,0 +1,12 @@
+"""The accounted shapes: explicit daemon, names whose prefixes the
+soak harness's _SUSPECT_THREADS table covers."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def start(work, k):
+    t = threading.Thread(target=work, name=f"prefetch-producer-{k}",
+                         daemon=True)
+    pool = ThreadPoolExecutor(max_workers=2,
+                              thread_name_prefix="store-readahead")
+    return t, pool

@@ -108,12 +108,19 @@ def test_braycurtis_unknown_method_rejected(rng):
     from spark_examples_tpu.pipelines import runner
 
     x = np.abs(rng.integers(0, 3, (8, 64), dtype=np.int8))
+    # Since the graftlint PR the bogus method dies at CONFIG time (the
+    # enum families are validated in ComputeConfig.__post_init__, flag
+    # named) — before any source/runner machinery exists.
+    with pytest.raises(ValueError, match="braycurtis-method"):
+        ComputeConfig(metric="braycurtis", braycurtis_method="fused")
+    # And a config mutated past validation still dies in the runner.
+    cfg = ComputeConfig(metric="braycurtis")
+    cfg.braycurtis_method = "fused"
     with pytest.raises(ValueError, match="braycurtis_method"):
         runner.run_similarity(
             JobConfig(
                 ingest=IngestConfig(block_variants=64),
-                compute=ComputeConfig(metric="braycurtis",
-                                      braycurtis_method="fused"),
+                compute=cfg,
             ),
             source=ArraySource(x),
         )

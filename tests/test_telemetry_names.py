@@ -6,46 +6,41 @@ joins back together. The same contract covers fault sites: every
 literal site string passed to ``faults.fire`` must be declared in
 ``faults.SITES`` — an undeclared site would be unarm-able from the env
 grammar (FaultSpec rejects unknown sites), i.e. a recovery path the
-chaos harness can never reach."""
+chaos harness can never reach.
+
+Since the graftlint PR these three lints run on the AST engine
+(tools/graftlint rules ``telemetry-name`` / ``fault-site``) instead of
+the original regex walkers: the AST rules additionally see through
+import aliasing (``from ... import telemetry as t``), string
+concatenation, and multi-line calls the regexes missed. Test names and
+failure-message contracts are unchanged."""
 
 import pathlib
 import re
 
 from spark_examples_tpu.core import faults, telemetry
+from tools import graftlint
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
-# Literal-name call sites: telemetry.<api>("name", ...). Dynamic names
-# (e.g. PhaseTimer's "phase." + name) are covered at runtime by the
-# registry's warn-and-count check instead — this lint is the static
-# half of the same contract.
-_CALL = re.compile(
-    r"\btelemetry\.(?:count|observe|gauge_set|event|begin|span|traced"
-    r"|counter_value)\(\s*([fr]?)([\"'])([^\"']+)\2"
-)
 
-
-def _source_files():
-    yield from (REPO / "spark_examples_tpu").rglob("*.py")
-    yield REPO / "bench.py"
+def _loc(finding) -> str:
+    return f"{pathlib.PurePosixPath(finding.path).name}:{finding.line}"
 
 
 def test_every_used_name_is_declared():
     undeclared = []
     fstring_sites = []
-    for path in _source_files():
-        text = path.read_text()
-        for m in _CALL.finditer(text):
-            prefix, _, name = m.groups()
-            line = text[: m.start()].count("\n") + 1
-            if "f" in prefix:
-                # An f-string name can't be statically checked — the
-                # registry's families + runtime check exist for dynamic
-                # names; literal sites must stay literal.
-                fstring_sites.append(f"{path.name}:{line}: f-string name")
-                continue
-            if not telemetry.is_declared(name):
-                undeclared.append(f"{path.name}:{line}: {name!r}")
+    for f in graftlint.run(rules=["telemetry-name"]):
+        if f.rule != "telemetry-name":
+            continue
+        if f.data.get("dynamic"):
+            # An f-string name can't be statically checked — the
+            # registry's families + runtime check exist for dynamic
+            # names; literal sites must stay literal.
+            fstring_sites.append(f"{_loc(f)}: f-string name")
+        else:
+            undeclared.append(f"{_loc(f)}: {f.data['name']!r}")
     assert not undeclared, (
         "telemetry names used but not declared in telemetry.NAMES "
         "(add them to the canonical registry): " + "; ".join(undeclared)
@@ -56,27 +51,22 @@ def test_every_used_name_is_declared():
     )
 
 
-_FIRE = re.compile(r"\bfaults\.fire\(\s*([fr]?)([\"'])([^\"']+)\2")
-
-
 def test_every_fault_site_is_declared():
     """Every literal site fired in production code is in faults.SITES
     (and dynamic names are banned outright: a site must be a greppable
     constant for the harness's docs and specs to reference it)."""
     undeclared = []
     fstring_sites = []
-    fired = set()
-    for path in _source_files():
-        text = path.read_text()
-        for m in _FIRE.finditer(text):
-            prefix, _, site = m.groups()
-            line = text[: m.start()].count("\n") + 1
-            if "f" in prefix:
-                fstring_sites.append(f"{path.name}:{line}: f-string site")
-                continue
-            fired.add(site)
-            if site not in faults.SITES:
-                undeclared.append(f"{path.name}:{line}: {site!r}")
+    dead: set[str] = set()
+    for f in graftlint.run(rules=["fault-site"]):
+        if f.rule != "fault-site":
+            continue
+        if f.data.get("dead"):
+            dead = set(f.data["dead"])
+        elif f.data.get("dynamic"):
+            fstring_sites.append(f"{_loc(f)}: f-string site")
+        else:
+            undeclared.append(f"{_loc(f)}: {f.data['site']!r}")
     assert not undeclared, (
         "fault sites fired but not declared in faults.SITES (declare "
         "them so specs can arm them): " + "; ".join(undeclared)
@@ -87,8 +77,7 @@ def test_every_fault_site_is_declared():
     )
     # The inverse direction: a declared site nothing fires is a dead
     # registry entry — the docs would promise an injection point the
-    # harness can't hit.
-    dead = set(faults.SITES) - fired
+    # harness can't hit (the rule's finalize pass, full-tree runs only).
     assert not dead, f"declared fault sites never fired in code: {dead}"
 
 
@@ -98,13 +87,14 @@ def test_every_fault_site_is_armed_by_a_test():
     an env-armed subprocess). A site that is fired in production code
     but never armed in a test is a recovery path the chaos harness has
     never actually reached; it rots exactly like untested code because
-    it IS untested code."""
-    text = "\n".join(
-        p.read_text() for p in (REPO / "tests").glob("*.py"))
-    kinds = "|".join(faults.KINDS)
+    it IS untested code. Spec strings are collected from the tests'
+    ASTs (every string constant, f-string fragments included) rather
+    than regexed from raw text."""
+    constants = graftlint.collect_string_constants([REPO / "tests"])
     unarmed = [
         site for site in faults.SITES
-        if not re.search(rf"{re.escape(site)}:(?:{kinds})", text)
+        if not any(f"{site}:{kind}" in s
+                   for s in constants for kind in faults.KINDS)
     ]
     assert not unarmed, (
         "fault sites declared in faults.SITES but never armed by any "

@@ -60,6 +60,12 @@ def test_metric_directions_resolve_sensibly():
     assert d("multichip_overlap_frac") == trend.HIGHER_IS_BETTER
     assert d("multichip_solve_n100k_s") == trend.LOWER_IS_BETTER
     assert d("multichip_ok") == trend.BOOL_MUST_HOLD
+    # Static-analysis gate (bench headline, the graftlint PR): the
+    # suite must stay clean — lint_ok HOLDS, and the finding count can
+    # only fall. A tree that got faster but picked up an invariant
+    # violation is a regression.
+    assert d("lint_ok") == trend.BOOL_MUST_HOLD
+    assert d("lint_findings") == trend.LOWER_IS_BETTER
 
 
 # ------------------------------------------------------------------ the band

@@ -1,0 +1,48 @@
+"""graftlint — AST-based invariant analyzers grown from the repo's own
+bug history.
+
+Every rule in ``tools/graftlint/rules`` is a distilled regression from a
+shipped PR (the motivating bug is named in each rule's docstring and in
+README "Static analysis"): the hard-coded CLI ``--metric`` choices list
+that made the freshly registered Jaccard kernel unreachable, donated jit
+buffers that XLA could never alias (or that the caller read back after
+the call), blocking I/O inside lock bodies that later deadlocked the
+SIGTERM flush path, raw ``open(path, "w")`` writes to durable artifacts
+that tore under kill, ``import jax`` leaking into the modules the
+supervised-CLI parent must import device-free, telemetry/fault-site
+name drift past the old regex lints, and unnamed/non-daemon threads the
+soak harness's leak accounting cannot see.
+
+Stdlib-only and jax-free at import: the whole suite is ``ast`` +
+``pathlib`` and may be run by the supervised CLI parent, CI, or bench
+without initializing any accelerator backend. Registries it validates
+against (kernel names, ``telemetry.NAMES``, ``faults.SITES``, the
+config enum tuples) are imported lazily at *check* time from modules
+that are themselves contractually jax-free — and the
+``jax-import-purity`` rule is what keeps that contract honest.
+
+Usage::
+
+    python -m tools.graftlint                    # whole repo, exit 1 on findings
+    python -m tools.graftlint --rules donation-safety,atomic-write
+    python -m tools.graftlint --format json path/to/file.py
+    python -m spark_examples_tpu lint            # same thing, CLI verb
+
+Suppressions are inline, per line, and MUST carry a reason::
+
+    with self._lock:
+        data = f.read()  # graftlint: disable=blocking-under-lock  # <why this one is safe>
+
+A reasonless suppression is itself a finding (``suppression-reason``):
+an exception nobody can re-evaluate is just a latent bug with a
+comment.
+"""
+
+from tools.graftlint.engine import (  # noqa: F401
+    Finding,
+    all_rules,
+    collect_string_constants,
+    format_findings,
+    run,
+)
+from tools.graftlint import rules as _rules  # noqa: F401  (registers)

@@ -1,0 +1,15 @@
+"""Importing this package registers every analyzer with the engine.
+
+One module per invariant family; each rule's docstring names the
+historical regression it distills (the fixture under
+``tests/fixtures/graftlint/`` replays it)."""
+
+from tools.graftlint.rules import (  # noqa: F401
+    atomic_write,
+    donation,
+    jax_purity,
+    locks,
+    names,
+    registry_literal,
+    threads,
+)

@@ -1,0 +1,262 @@
+"""donation-safety — donated jit buffers that cannot or must not be
+donated.
+
+Historical bug (PR 12): the sharded PCoA finalize jits donated int32
+``pieces`` leaves and scalar counters into float32 outputs. XLA aliases
+donated buffers by dtype/shape, so those donations bought nothing but a
+"Some donated buffers were not usable" warning on every multi-chip run
+— and a donation that DID take effect on a buffer the caller still
+reads would return garbage silently.
+
+Two lexical checks, function-scope, best-effort precise:
+
+- **read-after-donate**: a name passed in a donated position of a
+  known-donating callable is loaded again later in the same scope
+  without being reassigned first (the canonical safe shape is
+  ``acc = update(acc, block)`` — the rebind makes the old buffer
+  unreachable).
+- **non-alias-able leaf**: the donated argument is statically a scalar
+  literal or an integer/bool-dtyped array constructor
+  (``jnp.zeros(..., dtype=jnp.int32)``, ``np.int32(...)``, ...), which
+  XLA cannot alias into a float output.
+
+A "known-donating callable" is one defined in the same module via
+``jax.jit(f, donate_argnums=...)``,
+``partial(jax.jit, donate_argnums=...)(f)``, or the decorator form.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import Context, Rule, SourceFile, register
+from tools.graftlint.astutil import dotted, walk_scopes
+
+_INT_DTYPES = ("int8", "int16", "int32", "int64", "uint8", "uint16",
+               "uint32", "uint64", "bool_", "bool")
+_CTORS = ("zeros", "ones", "full", "empty", "asarray", "array",
+          "zeros_like", "ones_like", "full_like", "arange")
+
+
+def _donate_positions(call: ast.Call) -> frozenset[int] | None:
+    """Donated positional indices from a ``jax.jit``-shaped call's
+    ``donate_argnums=`` keyword, else None."""
+    if dotted(call.func) not in ("jax.jit", "jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return frozenset((v.value,))
+            if isinstance(v, (ast.Tuple, ast.List)):
+                idx = [e.value for e in v.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, int)]
+                if len(idx) == len(v.elts):
+                    return frozenset(idx)
+            return None  # dynamic spec: not statically analyzable
+    return None
+
+
+def _jit_factory_positions(node: ast.AST) -> frozenset[int] | None:
+    """Donated positions for either ``jax.jit(f, donate_argnums=...)``
+    or ``partial(jax.jit, donate_argnums=...)(f)`` / the same as a
+    decorator."""
+    if not isinstance(node, ast.Call):
+        return None
+    direct = _donate_positions(node)
+    if direct:
+        return direct
+    # partial(jax.jit, ...) used as a factory or a decorator
+    f = node.func
+    if isinstance(f, ast.Call) and dotted(f.func) in (
+            "partial", "functools.partial"):
+        if f.args and dotted(f.args[0]) in ("jax.jit", "jit"):
+            return _donate_positions(
+                ast.Call(func=f.args[0], args=[], keywords=f.keywords))
+    if dotted(f) in ("partial", "functools.partial"):
+        # the decorator form: @partial(jax.jit, donate_argnums=...)
+        if node.args and dotted(node.args[0]) in ("jax.jit", "jit"):
+            return _donate_positions(
+                ast.Call(func=node.args[0], args=[],
+                         keywords=node.keywords))
+    return None
+
+
+def _is_nonaliasable(expr: ast.AST) -> str | None:
+    """Why this expression's value cannot alias into a float output:
+    'a scalar literal' / 'an <dtype>-dtyped array', else None."""
+    if isinstance(expr, ast.Constant) and isinstance(
+            expr.value, (int, float, bool)):
+        return "a scalar literal"
+    if not isinstance(expr, ast.Call):
+        return None
+    d = dotted(expr.func) or ""
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf in _INT_DTYPES:
+        return f"an {leaf}-dtyped scalar/array"
+    if leaf in _CTORS:
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                dt = dotted(kw.value) or (
+                    kw.value.value if isinstance(kw.value, ast.Constant)
+                    else "")
+                dleaf = str(dt).rsplit(".", 1)[-1]
+                if dleaf in _INT_DTYPES:
+                    return f"an {dleaf}-dtyped array"
+    return None
+
+
+def _assigned_names(stmt: ast.stmt) -> set[str]:
+    out: set[str] = set()
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.With):
+        targets = [i.optional_vars for i in stmt.items if i.optional_vars]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+def _position(node: ast.AST) -> tuple[int, int]:
+    return (getattr(node, "end_lineno", node.lineno),
+            getattr(node, "end_col_offset", 0))
+
+
+@register
+class DonationSafetyRule(Rule):
+    id = "donation-safety"
+    invariant = ("donated jit arguments are alias-able float leaves and "
+                 "are never read after the donating call")
+    hint = ("rebind the result over the donated name "
+            "(acc = update(acc, ...)), and donate only float-dtyped "
+            "array leaves — split int32/scalar leaves out of "
+            "donate_argnums (the PR 12 fix)")
+
+    def check(self, src: SourceFile, ctx: Context):
+        if src.tree is None:
+            return
+        donors: dict[str, frozenset[int]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                pos = _jit_factory_positions(node.value)
+                if pos:
+                    donors[node.targets[0].id] = pos
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    pos = _jit_factory_positions(dec)
+                    if pos:
+                        donors[node.name] = pos
+        if not donors:
+            return
+
+        for scope, _body in walk_scopes(src.tree):
+            yield from self._check_scope(src, scope, donors)
+
+    def _scope_nodes(self, scope: ast.AST):
+        """All nodes lexically in this scope, excluding nested function
+        bodies (they run at another time, against other bindings)."""
+        stack = [scope]
+        first = True
+        while stack:
+            node = stack.pop()
+            if not first and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                continue
+            first = False
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_scope(self, src: SourceFile, scope: ast.AST,
+                     donors: dict[str, frozenset[int]]):
+        nodes = list(self._scope_nodes(scope))
+        # Latest visible constant-ish assignment per name, in source
+        # order — the dtype evidence for donated Name arguments.
+        assigns: list[tuple[tuple[int, int], str, ast.AST]] = []
+        loads: list[tuple[tuple[int, int], ast.Name]] = []
+        stores: list[tuple[tuple[int, int], str]] = []
+        calls: list[ast.Call] = []
+        stmt_of: dict[int, ast.stmt] = {}
+        for n in nodes:
+            # Map expressions to their innermost SIMPLE statement only
+            # (simple statements contain no other statements), so a
+            # call inside `for b in ...: acc = f(acc, b)` resolves to
+            # the Assign, not the For.
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                              ast.Expr, ast.Return)):
+                for sub in ast.walk(n):
+                    if not isinstance(sub, ast.stmt):
+                        stmt_of[id(sub)] = n
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                assigns.append(((n.lineno, n.col_offset),
+                                n.targets[0].id, n.value))
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Load):
+                    loads.append(((n.lineno, n.col_offset), n))
+                elif isinstance(n.ctx, ast.Store):
+                    stores.append(((n.lineno, n.col_offset), n.id))
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in donors:
+                calls.append(n)
+
+        for call in calls:
+            positions = donors[call.func.id]
+            end = _position(call)
+            # Does the statement containing this call rebind names (the
+            # `acc = update(acc, ...)` shape)? Those rebinds take
+            # effect immediately after the call for our purposes.
+            container = stmt_of.get(id(call))
+            rebound = _assigned_names(container) if container is not None \
+                else set()
+            for i in sorted(positions):
+                if i >= len(call.args):
+                    continue
+                arg = call.args[i]
+                evidence = arg
+                if isinstance(arg, ast.Name):
+                    before = [(p, v) for p, name, v in assigns
+                              if name == arg.id
+                              and p < (arg.lineno, arg.col_offset)]
+                    if before:
+                        evidence = max(before)[1]
+                why = _is_nonaliasable(evidence)
+                if why:
+                    yield self.finding(
+                        src, arg,
+                        f"argument {i} of {call.func.id}() is donated "
+                        f"but is {why} — XLA aliases by dtype/shape, "
+                        "so this donation is unusable against float "
+                        "outputs (PR 12's 'donated buffers were not "
+                        "usable' class)",
+                        kind="non-aliasable", callee=call.func.id)
+                if not isinstance(arg, ast.Name) or arg.id in rebound:
+                    continue
+                # read-after-donate: a later Load wins unless a Store
+                # rebinds the name first.
+                later_loads = [p for p, n in loads
+                               if n.id == arg.id and p > end]
+                if not later_loads:
+                    continue
+                first_load = min(later_loads)
+                rebind = [p for p, name in stores
+                          if name == arg.id and end < p < first_load]
+                if not rebind:
+                    load_node = next(n for p, n in loads
+                                     if n.id == arg.id and p == first_load)
+                    yield self.finding(
+                        src, load_node,
+                        f"{arg.id!r} was donated to {call.func.id}() at "
+                        f"line {call.lineno} and is read again here — a "
+                        "donated buffer's contents are undefined after "
+                        "the call",
+                        kind="read-after-donate", callee=call.func.id)

@@ -1,0 +1,162 @@
+"""jax-import-purity — the contractually device-free modules stay that
+way, transitively.
+
+Historical contract (PRs 6/8): the supervised-CLI parent must never
+hold a device — it imports ``cli.main``'s module surface (config,
+kernels, supervisor, faults, telemetry) BEFORE re-invoking the child,
+and a module-level ``import jax`` anywhere in that closure silently
+puts a jax runtime (and on TPU, the chip lock) into the watchdog
+process. The same purity is what lets config-time validation and the
+kernel registry run in the parent and in graftlint itself.
+
+The rule walks the module-level import graph (function-level imports
+are lazy by construction and excluded; ``if TYPE_CHECKING:`` blocks
+too) from each contract root and reports the import statement that
+begins a chain reaching ``jax``/``jaxlib``.
+
+Fixtures claim a contract identity with ``# graftlint: module=...``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from tools.graftlint.engine import Context, Rule, SourceFile, register
+
+# Module paths that must be importable without jax: the kernel registry
+# (config + CLI parent consume it), config-time validation, the fault
+# registry, the supervisor parent path, and the CLI module surface the
+# parent imports before any child exists.
+CONTRACT = (
+    "spark_examples_tpu.kernels",
+    "spark_examples_tpu.core.config",
+    "spark_examples_tpu.core.faults",
+    "spark_examples_tpu.core.telemetry",
+    "spark_examples_tpu.core.supervisor",
+    "spark_examples_tpu.cli.main",
+)
+
+_JAX_ROOTS = ("jax", "jaxlib")
+PACKAGE = "spark_examples_tpu"
+
+
+def _module_level_imports(tree: ast.Module):
+    """(node, dotted targets) for imports that execute at import time:
+    module body, class bodies, module-level try/if — but not function
+    bodies and not ``if TYPE_CHECKING:`` blocks."""
+    stack: list[ast.AST] = [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.If):
+            t = node.test
+            name = t.attr if isinstance(t, ast.Attribute) else (
+                t.id if isinstance(t, ast.Name) else "")
+            if name == "TYPE_CHECKING":
+                continue
+        if isinstance(node, ast.Import):
+            yield node, [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level or not node.module:
+                continue  # the repo uses absolute imports throughout
+            if node.module == "__future__":
+                continue
+            targets = []
+            for a in node.names:
+                # `from a.b import c` is module a.b.c when c is a
+                # module, else an attribute of a.b — try both.
+                targets.append(f"{node.module}.{a.name}")
+            targets.append(node.module)
+            yield node, targets
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _ancestors(dotted: str):
+    parts = dotted.split(".")
+    for i in range(1, len(parts)):
+        yield ".".join(parts[:i])
+
+
+@register
+class JaxImportPurityRule(Rule):
+    id = "jax-import-purity"
+    invariant = ("kernels/, core/config, core/faults, core/telemetry, "
+                 "core/supervisor, and cli/main import no jax at module "
+                 "level, transitively")
+    hint = ("move the jax import inside the function that needs it — "
+            "the supervised parent and config-time validation must run "
+            "device-free")
+
+    def _chain(self, ctx: Context, dotted: str,
+               cache: dict, visiting: set) -> list[str] | None:
+        """The module chain from ``dotted`` to a jax import, or None.
+        Only package-internal modules are walked; external deps other
+        than jax are leaves."""
+        root = dotted.split(".", 1)[0]
+        if root in _JAX_ROOTS:
+            return [dotted]
+        if root != PACKAGE:
+            return None
+        if dotted in cache:
+            return cache[dotted]
+        if dotted in visiting:
+            return None  # import cycle: resolved by the other branch
+        path = ctx.module_file(dotted)
+        if path is None:
+            return None
+        visiting.add(dotted)
+        chain = None
+        try:
+            tree = ast.parse(path.read_text())
+        except (OSError, SyntaxError):
+            visiting.discard(dotted)
+            cache[dotted] = None
+            return None
+        for _node, targets in _module_level_imports(tree):
+            for target in targets:
+                sub = self._resolve(ctx, target, cache, visiting)
+                if sub:
+                    chain = [dotted] + sub
+                    break
+            if chain:
+                break
+        visiting.discard(dotted)
+        cache[dotted] = chain
+        return chain
+
+    def _resolve(self, ctx: Context, target: str, cache, visiting):
+        """Chain for an import target, including the ancestor package
+        __init__ executions a dotted import implies."""
+        for anc in _ancestors(target):
+            if ctx.module_file(anc) is not None:
+                sub = self._chain(ctx, anc, cache, visiting)
+                if sub:
+                    return sub
+        if target.split(".", 1)[0] == PACKAGE and \
+                ctx.module_file(target) is None:
+            return None  # `from mod import attr` where attr is no module
+        return self._chain(ctx, target, cache, visiting)
+
+    def check(self, src: SourceFile, ctx: Context):
+        if src.tree is None or src.module is None:
+            return
+        if not any(src.module == c or src.module.startswith(c + ".")
+                   for c in CONTRACT):
+            return
+        cache = ctx.data.setdefault("jax_purity_cache", {})
+        for node, targets in _module_level_imports(src.tree):
+            for target in targets:
+                chain = self._resolve(ctx, target, cache, set())
+                if chain:
+                    arrow = " -> ".join([src.module] + chain)
+                    yield self.finding(
+                        src, node,
+                        f"module-level import reaches jax ({arrow}) — "
+                        f"{src.module} is contractually jax-free at "
+                        "import (the supervised parent / config-time "
+                        "path must never hold a device)",
+                        chain=chain)
+                    break

@@ -1,0 +1,72 @@
+"""registry-literal — hand-enumerated registry values drift.
+
+Historical bug (PR 11): the CLI shipped with a hard-coded ``--metric``
+``choices`` list, so the freshly registered Jaccard kernel was
+unreachable from the command line until a verify drive noticed. The
+same failure mode exists for every enum family that has a single
+source of truth: a literal collection re-listing its members goes
+silently stale the day the registry grows.
+
+The rule flags any list/tuple/set literal of >= 2 distinct strings
+drawn entirely from one registry family — kernel names (the live
+``spark_examples_tpu.kernels`` registry) or one of the config enum
+tuples (solver ladder, store codecs, tile2d transports, gram modes,
+eigh modes, braycurtis methods, backends, pack streams) — anywhere
+outside the family's defining module. Consumers must derive from the
+registry (``list(kernels.names())``, ``config.SOLVER_LADDER``, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import Context, Rule, SourceFile, register
+
+
+@register
+class RegistryLiteralRule(Rule):
+    id = "registry-literal"
+    invariant = ("enum collections are derived from their registry, "
+                 "never re-listed as literals")
+    hint = ("derive from the registry: list(kernels.names()), "
+            "config.SOLVER_LADDER, config.STORE_CODEC_SPECS, "
+            "config.TILE2D_TRANSPORTS, ...")
+
+    def _families(self, ctx: Context):
+        fams = [("kernel", ctx.kernel_names(),
+                 "spark_examples_tpu.kernels")]
+        for label, (values, mod) in ctx.config_enums().items():
+            fams.append((label, frozenset(values), mod))
+        return fams
+
+    def check(self, src: SourceFile, ctx: Context):
+        if src.tree is None:
+            return
+        families = self._families(ctx)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+                continue
+            if len(node.elts) < 2:
+                continue
+            values = [e.value for e in node.elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str)]
+            if len(values) != len(node.elts):
+                continue  # a non-string element: not an enum listing
+            distinct = set(values)
+            if len(distinct) < 2:
+                continue
+            for label, members, defining in families:
+                if distinct <= members:
+                    if src.module and (
+                            src.module == defining
+                            or src.module.startswith(defining + ".")):
+                        break  # the registry defining itself
+                    yield self.finding(
+                        src, node,
+                        f"literal collection of {label} registry values "
+                        f"{sorted(distinct)} outside {defining} — it "
+                        "goes stale when the registry grows (the PR 11 "
+                        "unreachable-Jaccard class)",
+                        family=label, values=sorted(distinct))
+                    break

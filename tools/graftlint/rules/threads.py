@@ -1,0 +1,131 @@
+"""thread-hygiene — threads the soak harness can account for.
+
+Historical contract (PR 6): the chaos soak's per-round leak accounting
+compares live threads against a fixture baseline BY NAME PREFIX
+(``tools/soak.py`` ``_SUSPECT_THREADS``). A ``threading.Thread``
+created without ``daemon=`` blocks interpreter exit on a crash path,
+and one without a ``name`` (or with a prefix the accounting table does
+not cover) is a leak the soak structurally cannot see — it rots exactly
+like untested code because it IS unaccounted code.
+
+Checks every ``threading.Thread(...)`` call (and
+``ThreadPoolExecutor``'s ``thread_name_prefix``): ``daemon=`` must be
+explicit, ``name=`` must be present, and a statically-known name
+prefix must be covered by ``_SUSPECT_THREADS`` (parsed from
+``tools/soak.py``'s AST — no import, so the rule stays jax-free).
+Dynamic prefixes (``thread_name_prefix=name``) are left to review.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import Context, Rule, SourceFile, register
+from tools.graftlint.astutil import dotted, import_aliases
+
+
+def _suspect_prefixes(ctx: Context) -> tuple[str, ...]:
+    key = "soak_thread_prefixes"
+    if key in ctx.data:
+        return ctx.data[key]
+    prefixes: tuple[str, ...] = ()
+    soak = ctx.root / "tools" / "soak.py"
+    try:
+        tree = ast.parse(soak.read_text())
+    except (OSError, SyntaxError):
+        ctx.data[key] = prefixes
+        return prefixes
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_SUSPECT_THREADS"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                prefixes = tuple(
+                    e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str))
+    ctx.data[key] = prefixes
+    return prefixes
+
+
+def _static_prefix(expr: ast.AST) -> str | None:
+    """The statically-known leading part of a thread name: a literal,
+    or an f-string's leading constant fragment. None = fully dynamic."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.JoinedStr) and expr.values:
+        head = expr.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    return None
+
+
+@register
+class ThreadHygieneRule(Rule):
+    id = "thread-hygiene"
+    invariant = ("threads carry daemon= and a name whose prefix the "
+                 "soak leak accounting (_SUSPECT_THREADS) covers")
+    hint = ("pass daemon= and name='<prefix>-...' where <prefix> is in "
+            "tools/soak.py _SUSPECT_THREADS (extend the table for a "
+            "new long-lived thread family)")
+
+    def check(self, src: SourceFile, ctx: Context):
+        if src.tree is None:
+            return
+        thread_names = {"threading.Thread"} | import_aliases(
+            src.tree, "threading.Thread")
+        pool_names = {"concurrent.futures.ThreadPoolExecutor",
+                      "futures.ThreadPoolExecutor"} | import_aliases(
+            src.tree, "concurrent.futures.ThreadPoolExecutor")
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d in thread_names:
+                yield from self._check_thread(src, ctx, node)
+            elif d in pool_names:
+                yield from self._check_pool(src, ctx, node)
+
+    def _check_thread(self, src, ctx, call: ast.Call):
+        kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        if "daemon" not in kwargs:
+            yield self.finding(
+                src, call,
+                "threading.Thread without daemon= — an implicit "
+                "non-daemon thread blocks interpreter exit on every "
+                "crash path")
+        if "name" not in kwargs:
+            yield self.finding(
+                src, call,
+                "threading.Thread without name= — the soak harness's "
+                "leak accounting tracks threads by name prefix; an "
+                "anonymous thread is a leak it cannot see")
+            return
+        yield from self._check_prefix(src, ctx, kwargs["name"],
+                                      "thread name")
+
+    def _check_pool(self, src, ctx, call: ast.Call):
+        kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        if "thread_name_prefix" not in kwargs:
+            yield self.finding(
+                src, call,
+                "ThreadPoolExecutor without thread_name_prefix= — its "
+                "anonymous workers are invisible to the soak leak "
+                "accounting")
+            return
+        yield from self._check_prefix(src, ctx,
+                                      kwargs["thread_name_prefix"],
+                                      "thread_name_prefix")
+
+    def _check_prefix(self, src, ctx, name_expr, what):
+        prefix = _static_prefix(name_expr)
+        if prefix is None:
+            return  # fully dynamic: review-time, not lint-time
+        covered = any(prefix.startswith(p)
+                      for p in _suspect_prefixes(ctx))
+        if not covered:
+            yield self.finding(
+                src, name_expr,
+                f"{what} {prefix!r} is outside the soak leak "
+                "accounting (tools/soak.py _SUSPECT_THREADS covers "
+                "none of it)")

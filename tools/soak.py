@@ -64,10 +64,16 @@ from spark_examples_tpu.store import quarantine as qledger
 from spark_examples_tpu.store.heal import origin_from_ingest
 from spark_examples_tpu.store.writer import compact
 
-# Thread-name prefixes the soak owns end to end: any of these still
-# alive after a round (and a GC + settle window) is a leak.
+# Thread-name prefixes the leak accounting covers: any of these still
+# alive after a round (and a GC + settle window) is a leak. This table
+# is also the graftlint thread-hygiene contract — EVERY named thread in
+# the production tree carries one of these prefixes, so a new thread
+# family that can leak must add itself here to pass tier-1.
 _SUSPECT_THREADS = ("store-readahead", "projection-serve-worker",
-                    "supervisor-heartbeat", "telemetry-flusher")
+                    "supervisor-heartbeat", "telemetry-flusher",
+                    "prefetch-producer", "partitioned-reader",
+                    "projection-http", "live-telemetry-http",
+                    "supervisor-live-proxy", "loadgen-client")
 
 # The in-process schedule: (job, site, kind, param ranges). `after` is
 # drawn per-round from its range so the fault lands at a different hit

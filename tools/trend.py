@@ -75,6 +75,10 @@ _EXPLICIT: dict[str, int | None] = {
     # hidden behind the link, the feed-saturation contract.
     "store_link_decode_overhead": LOWER_IS_BETTER,
     "cpu_baseline_s": None,  # the oracle's speed is not ours to gate
+    # graftlint finding count (bench headline): 0 on a clean tree; any
+    # rise is a regression regardless of perf. The companion lint_ok
+    # boolean rides the *_ok must-hold gate.
+    "lint_findings": LOWER_IS_BETTER,
     "chaos_soak_iterations": None,
     "chaos_soak_healed": None,
     "chaos_soak_faults_fired": None,
