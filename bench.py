@@ -31,6 +31,16 @@ by each kernel's own registered FLOPs model (headline
 ``kernel_jaccard_*`` / ``kernel_king_*`` / ``kernel_sweep_min_gflops``
 / ``kernel_sweep_ok``).
 
+``--fleet`` benches the multi-model fleet server (serve/fleet.py): a
+3-route fleet (ibs PCoA / shared-alt PCA / jaccard PCoA over separate
+store-backed panels) under a warm-pool budget sized for ~2.5 panels,
+driven by the multi-tenant loadgen mix (interactive + batch clients
+per route) so eviction/re-stage churn runs during the measurement, a
+per-route bit-identity check against the offline ``project`` path, and
+a hedged-vs-unhedged tail comparison on a delay-injected replica
+(headline ``fleet_routes`` / ``fleet_p99_interactive_s`` /
+``fleet_hedge_win_frac`` / ``fleet_evictions`` / ``fleet_ok``).
+
 ``--multichip`` measures the REAL sharded tile2d path (not a dryrun) on
 whatever mesh exists — all local chips, or an 8-virtual-device CPU mesh
 self-provisioned in a subprocess when this session has one device:
@@ -1238,6 +1248,166 @@ def bench_serve(store: str) -> dict:
     }
 
 
+FLEET_SAMPLES = 256    # per-route fleet panel cohort
+FLEET_VARIANTS = 8_192
+
+
+def bench_fleet() -> dict:
+    """``--fleet``: multi-tenant fleet serving numbers (ROADMAP item 2).
+
+    Three routes (ibs PCoA / shared-alt PCA / jaccard PCoA), each a
+    fitted model over its own store-compacted panel, served from ONE
+    process under a warm-pool budget sized for ~2.5 of the 3 panels —
+    so the multi-tenant mix (interactive + batch clients per route)
+    must churn LRU eviction + re-stage while it runs. Reported: the
+    mix's per-class p99s (the priority contract: interactive under
+    batch), sustained QPS, eviction/re-stage counts, per-route
+    bit-identity vs the offline ``project`` path, pool-under-budget,
+    quarantine cleanliness, and a hedged-vs-unhedged tail comparison
+    against a delay-injected replica (the primary holds every batch in
+    a long linger; the hedge lands on a fast replica sharing the same
+    stores as its cold tier)."""
+    import tempfile
+
+    from spark_examples_tpu.core import telemetry
+    from spark_examples_tpu.core.config import (
+        PRIORITY_CLASSES, ComputeConfig, IngestConfig, JobConfig,
+        ServeConfig,
+    )
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.pipelines.jobs import pcoa_job, variants_pca_job
+    from spark_examples_tpu.pipelines.project import pcoa_project_job
+    from spark_examples_tpu.serve import (
+        FleetManifest, build_fleet, run_fleet_loadgen, run_hedged_loadgen,
+    )
+    from spark_examples_tpu.store import quarantine as qledger
+    from spark_examples_tpu.store.writer import compact
+
+    n, nv = FLEET_SAMPLES, FLEET_VARIANTS
+    panel_bytes = n * nv
+    os.makedirs(CACHE, exist_ok=True)
+    workdir = tempfile.mkdtemp(prefix="bench_fleet_", dir=CACHE)
+    routes = []
+    panels = {}
+    for i, (name, kind, metric) in enumerate((
+            ("r-ibs", "pcoa", "ibs"),
+            ("r-pca", "pca", None),
+            ("r-jac", "pcoa", "jaccard"))):
+        rng = np.random.default_rng(21 + i)
+        g = np.where(rng.random((n, nv)) < 0.02, -1,
+                     rng.integers(0, 3, (n, nv))).astype(np.int8)
+        store_dir = os.path.join(workdir, f"store_{i}")
+        compact(store_dir, ArraySource(g), chunk_variants=2048)
+        model = os.path.join(workdir, f"model_{i}.npz")
+        job = JobConfig(
+            ingest=IngestConfig(block_variants=BLOCK),
+            compute=ComputeConfig(metric=metric, num_pc=8),
+            model_path=model,
+        )
+        (pcoa_job if kind == "pcoa" else variants_pca_job)(
+            job, source=ArraySource(g))
+        routes.append({"name": name, "model": model,
+                       "source": f"store:{store_dir}"})
+        panels[name] = (g, model, job, store_dir)
+    budget = int(panel_bytes * 2.5)
+    manifest = FleetManifest.parse(
+        {"routes": routes, "budget_mb": budget / 1e6})
+    cfg = ServeConfig(cache_entries=0, max_linger_ms=1.0)
+    fleet = build_fleet(manifest, cfg,
+                        ingest_defaults=IngestConfig(block_variants=BLOCK))
+    fleet.start()
+    ev0 = telemetry.counter_value("fleet.evictions")
+    rs0 = telemetry.counter_value("fleet.restage_total")
+    try:
+        # Per-route bit-identity vs the offline project path.
+        probe_rng = np.random.default_rng(5)
+        identical = True
+        for name, (g, model, job, _store) in panels.items():
+            q = np.where(probe_rng.random(nv) < 0.02, -1,
+                         probe_rng.integers(0, 3, nv)).astype(np.int8)
+            served = fleet.project(name, q, timeout=300.0)
+            offline = pcoa_project_job(
+                job.replace(model_path=None, output_path=None),
+                model_path=model,
+                source_new=ArraySource(q[None, :]),
+                source_ref=ArraySource(g),
+            ).coords
+            identical = identical and bool(np.array_equal(served, offline))
+        # The multi-tenant mix: 2 interactive + 4 batch clients/route.
+        pool_rng = np.random.default_rng(9)
+        pools = {
+            name: np.where(
+                pool_rng.random((96, nv)) < 0.02, -1,
+                pool_rng.integers(0, 3, (96, nv))).astype(np.int8)
+            for name in panels
+        }
+        mix = []
+        for name in sorted(panels):
+            mix.append((name, PRIORITY_CLASSES[0], 2))
+            mix.append((name, PRIORITY_CLASSES[1], 4))
+        report = run_fleet_loadgen(fleet, pools, mix,
+                                   requests_per_client=12,
+                                   result_timeout_s=300.0)
+        under_budget = fleet.pool.resident_bytes() <= budget
+        clean_stores = all(
+            qledger.load(store) == []
+            for _g, _m, _j, store in panels.values())
+        clean = fleet.drain()
+    finally:
+        fleet.close()
+    evictions = int(telemetry.counter_value("fleet.evictions") - ev0)
+    restages = int(telemetry.counter_value("fleet.restage_total") - rs0)
+    # Hedging: primary delay-injected via a long linger (every batch
+    # held 80 ms), backup fast, both over the same stores.
+    slow = build_fleet(
+        manifest, ServeConfig(cache_entries=0, max_linger_ms=80.0),
+        ingest_defaults=IngestConfig(block_variants=BLOCK)).start()
+    fast = build_fleet(
+        manifest, ServeConfig(cache_entries=0, max_linger_ms=0.0),
+        ingest_defaults=IngestConfig(block_variants=BLOCK)).start()
+    try:
+        unhedged = run_hedged_loadgen(
+            [slow, slow], pools["r-ibs"], clients=2,
+            requests_per_client=10, route="r-ibs",
+            hedge_floor_s=30.0, result_timeout_s=300.0)
+        hedged = run_hedged_loadgen(
+            [slow, fast], pools["r-ibs"], clients=2,
+            requests_per_client=10, route="r-ibs",
+            hedge_floor_s=0.02, result_timeout_s=300.0)
+    finally:
+        slow.close()
+        fast.close()
+    p99_i = report["per_class"][PRIORITY_CLASSES[0]]["p99_s"]
+    p99_b = report["per_class"][PRIORITY_CLASSES[1]]["p99_s"]
+    log(f"fleet: {len(routes)} routes, sustained "
+        f"{report['sustained_qps']} QPS, p99 interactive {p99_i * 1e3:.1f}"
+        f" ms vs batch {p99_b * 1e3:.1f} ms, {evictions} evictions / "
+        f"{restages} re-stages under a {budget / 1e6:.1f} MB budget, "
+        f"bit-identical={identical}; hedged p99 "
+        f"{hedged['p99_s'] * 1e3:.1f} ms vs unhedged "
+        f"{unhedged['p99_s'] * 1e3:.1f} ms "
+        f"(win frac {hedged['hedge_win_frac']})")
+    return {
+        "routes": len(routes),
+        "panel": [n, nv],
+        "budget_mb": round(budget / 1e6, 2),
+        "bit_identical_vs_offline": identical,
+        "clean_drain": clean,
+        "pool_under_budget": under_budget,
+        "stores_clean": clean_stores,
+        "evictions": evictions,
+        "restage_total": restages,
+        "mix": report,
+        "p99_interactive_s": p99_i,
+        "p99_batch_s": p99_b,
+        "hedge_unhedged_p99_s": unhedged["p99_s"],
+        "hedge_hedged_p99_s": hedged["p99_s"],
+        "hedge_win_frac": hedged["hedge_win_frac"],
+        "hedge_launched": hedged["hedge_launched"],
+        "hedge_errors": hedged["errors"] + unhedged["errors"],
+    }
+
+
 STORE_BENCH_VARIANTS = 16_384  # store-bench cohort width (full N_SAMPLES)
 STORE_BENCH_CHUNK = 2_048      # store-bench chunk grid: 8 chunks, so the
                                # readahead pool / adaptive depth have a
@@ -1815,6 +1985,13 @@ def main() -> None:
             log(f"serve FAILED: {e!r}")
             configs["serve"] = {"error": repr(e)}
 
+    if "--fleet" in sys.argv:
+        try:
+            configs["fleet"] = bench_fleet()
+        except Exception as e:
+            log(f"fleet FAILED: {e!r}")
+            configs["fleet"] = {"error": repr(e)}
+
     if "--store" in sys.argv:
         try:
             configs["store"] = bench_store(store)
@@ -1913,6 +2090,25 @@ def main() -> None:
         headline["serve_ok"] = bool(
             configs["serve"]["bit_identical_vs_offline"]
             and configs["serve"]["clean_drain"]
+        )
+    if "fleet" in configs and "error" not in configs["fleet"]:
+        fl = configs["fleet"]
+        headline["fleet_routes"] = fl["routes"]
+        headline["fleet_p99_interactive_s"] = fl["p99_interactive_s"]
+        headline["fleet_p99_batch_s"] = fl["p99_batch_s"]
+        headline["fleet_sustained_qps"] = fl["mix"]["sustained_qps"]
+        headline["fleet_evictions"] = fl["evictions"]
+        headline["fleet_hedge_win_frac"] = fl["hedge_win_frac"]
+        headline["fleet_ok"] = bool(
+            fl["bit_identical_vs_offline"]
+            and fl["clean_drain"]
+            and fl["pool_under_budget"]
+            and fl["stores_clean"]
+            and fl["evictions"] > 0
+            and fl["mix"]["errors"] == 0
+            and fl["p99_interactive_s"] <= fl["p99_batch_s"]
+            and fl["hedge_hedged_p99_s"] < fl["hedge_unhedged_p99_s"]
+            and fl["hedge_errors"] == 0
         )
     if "store" in configs and "error" not in configs["store"]:
         headline["store_hit_vs_cold_parse"] = configs["store"][

@@ -431,11 +431,44 @@ def main(argv: list[str] | None = None) -> int:
         "an async micro-batching queue (bit-identical to the offline "
         "`project` CLI); default mode binds a local HTTP endpoint, "
         "--loadgen N instead drives it with N closed-loop clients and "
-        "prints the serving report",
+        "prints the serving report. --fleet fleet.json switches to "
+        "FLEET mode: many named (model, panel) routes in one process "
+        "under an HBM-budgeted warm panel pool with LRU eviction and "
+        "priority-class admission (see README 'Fleet serving')",
     )
     _add_common(p_srv)  # --source/--path describe the LOADGEN query pool
-    p_srv.add_argument("--model", required=True,
-                       help=".npz from pcoa/pca --save-model")
+    p_srv.add_argument("--model", default=None,
+                       help=".npz from pcoa/pca --save-model "
+                       "(single-model mode; --fleet replaces it)")
+    p_srv.add_argument("--fleet", default=None, metavar="MANIFEST",
+                       help="fleet manifest JSON (route registry: "
+                       "name -> model path + panel source); serves "
+                       "every route from one process — POST /project "
+                       "with a 'route' field, or /project/<route>")
+    p_srv.add_argument("--fleet-budget-mb", type=float,
+                       default=config.ServeConfig.fleet_budget_mb,
+                       help="warm panel pool budget (fleet mode): "
+                       "staged panels past it are LRU-evicted and "
+                       "re-stage on demand through the store "
+                       "(fleet.restage_total counts the cold starts); "
+                       "a budget_mb in the manifest wins")
+    p_srv.add_argument("--queue-interactive", type=int,
+                       default=config.ServeConfig.queue_interactive,
+                       help="interactive-class admission bound (fleet "
+                       "mode): the protected class's shed threshold")
+    p_srv.add_argument("--queue-batch", type=int,
+                       default=config.ServeConfig.queue_batch,
+                       help="batch-class admission bound (fleet mode): "
+                       "backfill sheds here first under overload while "
+                       "interactive keeps admitting")
+    p_srv.add_argument("--deadline-interactive-ms", type=float,
+                       default=config.ServeConfig.deadline_interactive_ms,
+                       help="default deadline for interactive-class "
+                       "requests (fleet mode; 0 = none)")
+    p_srv.add_argument("--deadline-batch-ms", type=float,
+                       default=config.ServeConfig.deadline_batch_ms,
+                       help="default deadline for batch-class requests "
+                       "(fleet mode; 0 = none)")
     p_srv.add_argument("--ref-source", default="packed",
                        type=_source_arg,
                        metavar="{" + ",".join(_SOURCES) + "}",
@@ -988,19 +1021,36 @@ def _run_serve(args, parser, job, build_source) -> int:
         ProjectionEngine, ProjectionServer, run_loadgen,
     )
 
-    if _needs_ref_path(args):
+    if not args.fleet and not args.model:
+        parser.error("serve needs --model MODEL.npz (single-model "
+                     "mode) or --fleet fleet.json (multi-model mode)")
+    if args.fleet and args.model:
+        parser.error("--fleet and --model are mutually exclusive: the "
+                     "fleet manifest names every route's model")
+    if not args.fleet and _needs_ref_path(args):
         parser.error("serve requires --ref-path (the panel genotypes "
                      "the model was fitted on)")
-    cfg = config.ServeConfig(
-        model_path=args.model,
-        max_batch=args.max_batch,
-        max_linger_ms=args.max_linger_ms,
-        max_queue=args.max_queue,
-        cache_entries=args.cache_entries,
-        deadline_ms=args.deadline_ms,
-        host=args.host,
-        port=args.port,
-    )
+    try:
+        cfg = config.ServeConfig(
+            model_path=args.model,
+            max_batch=args.max_batch,
+            max_linger_ms=args.max_linger_ms,
+            max_queue=args.max_queue,
+            cache_entries=args.cache_entries,
+            deadline_ms=args.deadline_ms,
+            host=args.host,
+            port=args.port,
+            fleet_manifest=args.fleet,
+            fleet_budget_mb=args.fleet_budget_mb,
+            queue_interactive=args.queue_interactive,
+            queue_batch=args.queue_batch,
+            deadline_interactive_ms=args.deadline_interactive_ms,
+            deadline_batch_ms=args.deadline_batch_ms,
+        )
+    except ValueError as e:
+        parser.error(str(e))
+    if args.fleet:
+        return _run_serve_fleet(args, parser, job, cfg, build_source)
     ref_cfg = _dc.replace(job.ingest, source=args.ref_source,
                           path=args.ref_path)
     engine = ProjectionEngine(
@@ -1075,6 +1125,82 @@ def _run_serve(args, parser, job, build_source) -> int:
                 http.shutdown()
     finally:
         server.close()
+    return 0
+
+
+def _run_serve_fleet(args, parser, job, cfg, build_source) -> int:
+    """`serve --fleet`: manifest -> FleetRouter; then either the fleet
+    HTTP front (Ctrl-C/SIGTERM drains) or a multi-tenant loadgen mix
+    (per route: --loadgen interactive + --loadgen batch clients) whose
+    JSON report goes to stdout."""
+    import dataclasses as _dc
+
+    from spark_examples_tpu.core.config import PRIORITY_CLASSES
+    from spark_examples_tpu.serve import (
+        FleetFormatError, FleetManifest, build_fleet, run_fleet_loadgen,
+    )
+
+    try:
+        manifest = FleetManifest.load(cfg.fleet_manifest)
+        fleet = build_fleet(manifest, cfg, ingest_defaults=job.ingest,
+                            block_variants=job.ingest.block_variants)
+    except (FleetFormatError, ValueError, OSError) as e:
+        parser.error(str(e))
+    fleet.start()
+    try:
+        if args.loadgen > 0:
+            pools = {}
+            for name, route in fleet.routes.items():
+                q_cfg = job.ingest
+                if q_cfg.source == "synthetic":
+                    q_cfg = _dc.replace(
+                        q_cfg, n_variants=route.n_variants
+                        or q_cfg.n_variants)
+                q_src = build_source(q_cfg)
+                pools[name] = np.concatenate(
+                    [b for b, _ in q_src.blocks(q_cfg.block_variants)],
+                    axis=1,
+                )
+            mix = [(name, cls, args.loadgen)
+                   for name in sorted(fleet.routes)
+                   for cls in PRIORITY_CLASSES]
+            report = run_fleet_loadgen(
+                fleet, pools, mix,
+                requests_per_client=args.loadgen_requests,
+            )
+            report["stats"] = fleet.stats_payload()
+            print(json.dumps(report, sort_keys=True))
+        else:
+            import signal
+
+            from spark_examples_tpu.serve.http import fleet_http_server
+
+            http = fleet_http_server(fleet, host=cfg.host, port=cfg.port)
+
+            def _sigterm(signum, frame):
+                raise KeyboardInterrupt
+
+            try:
+                signal.signal(signal.SIGTERM, _sigterm)
+            except ValueError:
+                pass  # not the main thread (embedded use) — skip
+            print(
+                f"serving fleet of {len(fleet.routes)} route(s) on "
+                f"http://{http.host}:{http.port} (POST /project "
+                "{'route': ..., 'genotypes': [...], 'priority': ...}, "
+                "GET /routes, /healthz, /stats, /metrics; pool budget "
+                f"{fleet.pool.budget_bytes / 1e6:.0f} MB; Ctrl-C "
+                "drains)",
+                file=sys.stderr,
+            )
+            try:
+                http.serve_forever()
+            except KeyboardInterrupt:
+                print("draining...", file=sys.stderr)
+            finally:
+                http.shutdown()
+    finally:
+        fleet.close()
     return 0
 
 

@@ -33,6 +33,13 @@ STORE_CODEC_SPECS = ("raw", "zlib", "zlib-dict")
 # anywhere else goes stale the day a member is added (the PR 11
 # unreachable-Jaccard failure mode, generalized).
 BACKENDS = ("jax-tpu", "cpu-reference")
+# Admission priority classes of the serving layer (serve/router.py):
+# "interactive" requests drain strictly before "batch" backfill in the
+# fleet worker's dequeue order, and each class carries its own shed
+# threshold and default deadline (ServeConfig). Order matters — earlier
+# is higher priority, and PRIORITY_CLASSES[0] is the default class.
+PRIORITY_CLASSES = ("interactive", "batch")
+DEFAULT_PRIORITY = PRIORITY_CLASSES[0]
 # The resolved per-plan modes (what parallel/gram_sharded executes);
 # the config flag additionally accepts "auto" (resolved by plan_for).
 GRAM_PLAN_MODES = ("replicated", "variant", "tile2d")
@@ -486,6 +493,19 @@ class ServeConfig:
     ``deadline_ms`` (0 = none) is the default per-request deadline;
     ``cache_entries`` (0 = off) sizes the LRU result cache keyed by
     genotype digest.
+
+    Fleet mode (``serve --fleet fleet.json``; serve/fleet.py): one
+    process routes requests across many named (model, panel) routes.
+    ``fleet_manifest`` names the route registry; ``fleet_budget_mb``
+    bounds the warm panel pool (staged panels past it are LRU-evicted
+    and re-stage on demand through the store — counted in
+    ``fleet.restage_total``). The admission queue gains the
+    PRIORITY_CLASSES: per-class shed thresholds
+    (``queue_interactive``/``queue_batch`` — interactive keeps
+    admitting after batch backfill has been shed) and per-class default
+    deadlines (``deadline_interactive_ms``/``deadline_batch_ms``;
+    0 = none, and an explicit ``deadline_ms`` request field still
+    overrides).
     """
 
     model_path: str | None = None
@@ -496,6 +516,48 @@ class ServeConfig:
     deadline_ms: float = 0.0
     host: str = "127.0.0.1"
     port: int = 8777
+    # Fleet serving (serve/fleet.py) — None = single-model mode.
+    fleet_manifest: str | None = None
+    fleet_budget_mb: float = 1024.0
+    queue_interactive: int = 64
+    queue_batch: int = 256
+    deadline_interactive_ms: float = 0.0
+    deadline_batch_ms: float = 0.0
+
+    def __post_init__(self):
+        # Knob validation AT CONFIG TIME with the flag named (the
+        # IngestConfig convention): a nonsense serving knob must die as
+        # a usage error, not as a wedged admission queue or a worker
+        # traceback under live traffic.
+        def _check(flag, value, lo, hi, why):
+            if not (isinstance(value, (int, float)) and lo <= value <= hi):
+                raise ValueError(
+                    f"bad serve config: {flag}={value!r} — expected a "
+                    f"number in [{lo}, {hi}] ({why})"
+                )
+
+        _check("--max-batch", self.max_batch, 1, 4096,
+               "micro-batch ceiling; batches pad to it")
+        _check("--max-linger-ms", self.max_linger_ms, 0.0, 60_000.0,
+               "max coalescing wait past the first queued query")
+        _check("--max-queue", self.max_queue, 1, 1 << 20,
+               "bounded admission queue; a full queue sheds")
+        _check("--cache-entries", self.cache_entries, 0, 1 << 20,
+               "LRU result cache size; 0 disables")
+        _check("--deadline-ms", self.deadline_ms, 0.0, 86_400_000.0,
+               "default per-request deadline; 0 = none")
+        _check("--fleet-budget-mb", self.fleet_budget_mb, 0.001, 1 << 24,
+               "warm panel pool budget for fleet mode")
+        _check("--queue-interactive", self.queue_interactive, 1, 1 << 20,
+               "interactive-class shed threshold (fleet admission)")
+        _check("--queue-batch", self.queue_batch, 1, 1 << 20,
+               "batch-class shed threshold (fleet admission)")
+        _check("--deadline-interactive-ms", self.deadline_interactive_ms,
+               0.0, 86_400_000.0,
+               "interactive-class default deadline; 0 = none")
+        _check("--deadline-batch-ms", self.deadline_batch_ms,
+               0.0, 86_400_000.0,
+               "batch-class default deadline; 0 = none")
 
 
 @dataclass

@@ -67,6 +67,13 @@ Sites instrumented in production code:
                             the hang and restart; ``io_error`` fails
                             one write (tolerated, warned, never fatal
                             to the job thread)
+``fleet.stage``             per panel stage into the fleet serving
+                            warm pool (serve/pool.py), fired before the
+                            panel's source streams — ``io_error`` fails
+                            exactly the requests waiting on that stage
+                            (and feeds the route's circuit breaker),
+                            ``delay`` is a slow cold tier at re-stage
+                            time, ``kill`` a preemption mid-stage
 ``telemetry.flush``         per periodic live-telemetry flush
                             (core/telemetry.py PeriodicFlusher), fired
                             with the metrics.json path before the
@@ -113,6 +120,7 @@ SITES = (
     "multihost.consensus",
     "device.put",
     "serve.request",
+    "fleet.stage",
     "store.read",
     "store.readahead.decode",
     "prefetch.transfer_wait",

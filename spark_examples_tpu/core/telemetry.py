@@ -152,6 +152,13 @@ NAMES: dict[str, tuple[str, str]] = {
         "(single-pass rung) or Rayleigh Ritz pairs (corrected) from the "
         "(N, rank) sketch state — rank-sized math, never an N x N eigh",
     ),
+    "fleet.stage": (
+        "span",
+        "one reference panel staged (or re-staged after an LRU "
+        "eviction) into the fleet serving warm pool through the store "
+        "read path (serve/pool.py) — the cold-start cost the pool's "
+        "budget trades against panel residency",
+    ),
     "live.flush": (
         "span",
         "one periodic live-telemetry flush: the telemetry.flush fault "
@@ -350,6 +357,56 @@ NAMES: dict[str, tuple[str, str]] = {
         "repeated staging failures opened the breaker and the server "
         "entered cached-panel-only mode (still serving, degraded)",
     ),
+    "fleet.restage_total": (
+        "counter",
+        "panel stages of a route that had been staged before and was "
+        "LRU-evicted from the warm pool — each is a cold start paid to "
+        "the HBM budget (a climbing rate under steady traffic means "
+        "the budget is too small for the working set)",
+    ),
+    "fleet.evictions": (
+        "counter",
+        "panels LRU-evicted from the fleet warm pool to fit a newly "
+        "staged route under the configured budget (the panel re-stages "
+        "on demand through the store — nothing is lost, only warmth)",
+    ),
+    "fleet.cache_namespace_evictions": (
+        "counter",
+        "result-cache entries reclaimed because their route was "
+        "unloaded (the cache is namespaced by model fingerprint; an "
+        "unloaded route's namespace is evicted whole, so cache bytes "
+        "stay flat across load/unload cycles)",
+    ),
+    "fleet.hedge_launched": (
+        "counter",
+        "hedge requests the loadgen client sent to a second replica "
+        "after the p95-derived hedge delay passed without a primary "
+        "answer (serve/loadgen.py run_hedged_loadgen)",
+    ),
+    "fleet.hedge_wins": (
+        "counter",
+        "hedged requests whose SECOND replica answered first (the "
+        "primary was the straggler; the loser future is cancelled) — "
+        "hedge_wins / hedge_launched is the tail-latency relief rate",
+    ),
+    "serve.priority.preemptions": (
+        "counter",
+        "dequeues where an interactive request jumped ahead of an "
+        "older batch-class request waiting in admission — the priority "
+        "contract (interactive before batch) actually exercised",
+    ),
+    "serve.priority.shed_interactive": (
+        "counter",
+        "interactive-class requests shed at admission (the "
+        "--queue-interactive threshold; nonzero means even the "
+        "protected class is past capacity — scale out)",
+    ),
+    "serve.priority.shed_batch": (
+        "counter",
+        "batch-class requests shed at admission (the --queue-batch "
+        "threshold) — expected first under overload, while the "
+        "interactive class keeps admitting",
+    ),
     "live.flushes": (
         "counter",
         "periodic live-telemetry snapshots published by the background "
@@ -413,6 +470,43 @@ NAMES: dict[str, tuple[str, str]] = {
         "1 degraded, 2 draining) — published on every transition so "
         "the exported timeline shows when and how long the server was "
         "degraded; /healthz reports the same state as a string",
+    ),
+    "fleet.routes": (
+        "gauge",
+        "routes currently loaded in the fleet server (each = one "
+        "(model, panel) pair addressable by name)",
+    ),
+    "fleet.pool_bytes": (
+        "gauge",
+        "staged panel bytes resident in the fleet warm pool (dense "
+        "device-resident blocks); bounded by the configured "
+        "--fleet-budget-mb via LRU eviction",
+    ),
+    "fleet.pool_pressure": (
+        "gauge",
+        "resident / budget of the fleet warm pool (1.0 = at budget; "
+        "sustained ~1.0 with climbing fleet.restage_total means the "
+        "working set does not fit and cold starts are being paid)",
+    ),
+    "fleet.route.*": (
+        "gauge",
+        "per-route autoscale signals, one gauge per "
+        "fleet.route.<name>.<signal>: queue_depth (admitted waiting), "
+        "p99_s (served latency), shed_rate (shed / offered), staged "
+        "(1 = panel warm in the pool) — the series an autoscaler "
+        "scales replica counts on (GET /metrics)",
+    ),
+    "serve.priority.depth_interactive": (
+        "gauge",
+        "interactive-class admission queue depth (published at every "
+        "put/take; pinned at the --queue-interactive bound means the "
+        "protected class itself is saturated)",
+    ),
+    "serve.priority.depth_batch": (
+        "gauge",
+        "batch-class admission queue depth — deep-and-draining is the "
+        "designed steady state under mixed load (backfill absorbs the "
+        "slack the interactive class leaves)",
     ),
     "store.cache_bytes": (
         "gauge",
@@ -613,6 +707,22 @@ class Histogram:
             self.min = v
         if v > self.max:
             self.max = v
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` into this histogram (identical bucket grid by
+        construction). The aggregation primitive client-side latency
+        tracking uses (serve/loadgen.py) — one implementation, so a
+        bucket-layout change can never skew a caller's own fold."""
+        if other.count:
+            for i, n in enumerate(other.buckets):
+                self.buckets[i] += n
+            self.count += other.count
+            self.sum += other.sum
+            if other.min < self.min:
+                self.min = other.min
+            if other.max > self.max:
+                self.max = other.max
+        return self
 
     @staticmethod
     def _bounds(i: int) -> tuple[float, float]:

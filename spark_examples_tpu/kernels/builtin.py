@@ -395,6 +395,64 @@ register(Kernel(
 ))
 
 
+# -------------------------------------------------------- pc-invariant
+
+def _pc_invariant_finalize(stats):
+    import jax.numpy as jnp
+
+    # Piecewise-constant invariant similarity (arXiv:2404.07183): the
+    # per-variant pair contribution is an arbitrary piecewise-constant
+    # function W(a, b) of the two dosages, assembled from indicator
+    # cross-products. This registration instantiates the canonical
+    # relatedness-flavored table
+    #     W = [[+1, 0, -1], [0, +1, 0], [-1, 0, +1]]
+    # (+1 identical genotype, -1 opposite homozygotes, 0 otherwise)
+    # over pairwise-complete variants, normalized by the valid-pair
+    # count m: s = (ibs2 - opp) / m in [-1, 1]. The numerator is
+    # exactly the existing integer statistics recombined — the paper's
+    # point, and the registry's declared extension contract: ANY such
+    # table is one registration in the pieces/stats algebra, no new
+    # matmuls. Pairs sharing no complete variants score 1 (the
+    # indistinguishable-from-identical convention ibs/jaccard use), so
+    # the diagonal is exactly 1 and the distance (1 - s) / 2 in [0, 1]
+    # has an exactly-zero self-distance — safe under Gower centering.
+    m = stats["m"].astype(jnp.float32)
+    num = (stats["ibs2"] - stats["opp"]).astype(jnp.float32)
+    sim = jnp.where(m > 0, num / m, 1.0)
+    return {"similarity": sim, "distance": (1.0 - sim) / 2.0}
+
+
+def _pc_invariant_np_finalize(acc):
+    import numpy as np
+
+    with np.errstate(invalid="ignore", divide="ignore"):
+        sim = np.where(acc["m"] > 0,
+                       (acc["ibs2"] - acc["opp"]) / acc["m"], 1.0)
+    return {"similarity": sim, "distance": (1.0 - sim) / 2.0}
+
+
+register(Kernel(
+    name="pc-invariant",
+    summary="piecewise-constant invariant similarity (arXiv:2404.07183"
+            " construction): per-variant table +1 identical genotype, "
+            "-1 opposite homozygotes, normalized per complete pair",
+    family="count",
+    pieces=("cc", "t1c", "t2c", "t1t1", "t1t2", "t2t2"),
+    stats=("m", "ibs2", "opp"),
+    finalize=_pc_invariant_finalize,
+    np_finalize=_pc_invariant_np_finalize,
+    pack_auto=True,
+    # ibs2's combine sums indicator products with coefficient 2 (the
+    # same reason ibs2/king register 2); the finalize's ibs2 - opp
+    # stays within that per-variant budget.
+    max_increment=2,
+    flops=_count_flops(("cc", "t1c", "t2c", "t1t1", "t1t2", "t2t2")),
+    # No sketch spec: the table is indefinite (the -1 off-diagonal
+    # blocks), so neither the exact-Gram factor form nor the PSD dual
+    # numerator applies — exact rung only, like king.
+))
+
+
 # ---------------------------------------------------------------- grm
 
 def _grm_finalize(stats):

@@ -19,21 +19,46 @@ Layers:
   device-resident model + panel + compiled step (no queueing).
 - :class:`~spark_examples_tpu.serve.server.ProjectionServer` — the
   async micro-batcher and admission envelope over one engine.
-- :mod:`~spark_examples_tpu.serve.http` — a thin stdlib HTTP front.
+- :mod:`~spark_examples_tpu.serve.http` — a thin stdlib HTTP front
+  (single-model and fleet).
 - :mod:`~spark_examples_tpu.serve.loadgen` — the closed-loop load
-  generator behind ``bench.py --serve`` and the ``serve --loadgen``
-  CLI mode (offered vs sustained QPS, latency p50/p99).
+  generators behind ``bench.py --serve`` / ``--fleet`` and the
+  ``serve --loadgen`` CLI mode (offered vs sustained QPS, latency
+  p50/p99, the multi-tenant fleet mix, replica hedging).
+
+Fleet serving (``serve --fleet fleet.json``) routes many named
+(model, panel) pairs through ONE process:
+
+- :mod:`~spark_examples_tpu.serve.pool` — the warm panel pool: staged
+  panels under an explicit HBM/host-RAM budget with LRU eviction;
+  evicted panels re-stage on demand through the content-addressed
+  store (the shared cold tier across replica processes).
+- :mod:`~spark_examples_tpu.serve.router` — priority-class admission
+  (interactive preempts batch backfill) + the fleet batching worker.
+- :mod:`~spark_examples_tpu.serve.fleet` — the manifest registry and
+  fleet assembly.
 """
 
 from spark_examples_tpu.serve.cache import ResultCache, genotype_digest
 from spark_examples_tpu.serve.engine import ProjectionEngine
+from spark_examples_tpu.serve.fleet import (
+    FleetFormatError,
+    FleetManifest,
+    build_fleet,
+)
 from spark_examples_tpu.serve.health import (
     DEGRADED,
     DRAINING,
     HEALTHY,
     CircuitBreaker,
 )
-from spark_examples_tpu.serve.loadgen import run_loadgen
+from spark_examples_tpu.serve.loadgen import (
+    run_fleet_loadgen,
+    run_hedged_loadgen,
+    run_loadgen,
+)
+from spark_examples_tpu.serve.pool import PanelPool, PanelUnavailable
+from spark_examples_tpu.serve.router import FleetRouter, UnknownRoute
 from spark_examples_tpu.serve.server import (
     DeadlineExceeded,
     ProjectionServer,
@@ -46,12 +71,21 @@ __all__ = [
     "DEGRADED",
     "DRAINING",
     "DeadlineExceeded",
+    "FleetFormatError",
+    "FleetManifest",
+    "FleetRouter",
     "HEALTHY",
+    "PanelPool",
+    "PanelUnavailable",
     "ProjectionEngine",
     "ProjectionServer",
     "ResultCache",
     "ServerClosed",
     "ServerOverloaded",
+    "UnknownRoute",
+    "build_fleet",
     "genotype_digest",
+    "run_fleet_loadgen",
+    "run_hedged_loadgen",
     "run_loadgen",
 ]

@@ -1,11 +1,21 @@
-"""LRU result cache for the projection server.
+"""LRU result cache for the projection servers (single-model + fleet).
 
-Keyed by a digest of the query genotype block (plus the model's content
-fingerprint as a namespace, so a hot-reloaded model can never serve a
-stale result). Values are the final (1, k) coordinate rows — tiny next
-to the cross-statistics work a miss costs, so a few hundred entries are
-effectively free and absorb the classic serving pattern of repeated
-identical queries (retries, duplicate submissions, shared panels).
+Keyed by a digest of the query genotype block plus the serving model's
+content fingerprint as an explicit **namespace** — a hot-reloaded model
+(or a different fleet route) can never serve a stale result. Values are
+the final (1, k) coordinate rows — tiny next to the cross-statistics
+work a miss costs, so a few hundred entries are effectively free and
+absorb the classic serving pattern of repeated identical queries
+(retries, duplicate submissions, shared panels).
+
+The namespace is a first-class index, not a hash ingredient: a
+multi-model fleet unloads routes at runtime, and entries namespaced by
+a gone model's fingerprint would otherwise sit in the LRU until
+coincidental pressure evicted them — never matched, never reclaimed.
+:meth:`ResultCache.evict_namespace` reclaims a route's entries whole on
+unload (counted in ``fleet.cache_namespace_evictions``), and
+:meth:`ResultCache.stats` exposes the entry/byte accounting the
+lifecycle test pins flat across a load/unload loop.
 """
 
 from __future__ import annotations
@@ -22,37 +32,44 @@ def genotype_digest(genotypes: np.ndarray, namespace: str = "") -> str:
     """Content digest of one query's genotype block.
 
     Shape and dtype are folded in so a (V,) int8 query and some other
-    buffer with the same bytes cannot collide; ``namespace`` carries the
-    model fingerprint (ProjectionModel.digest()). Delegates to the
-    shared encoding in core/hashing.py (the store and checkpoint layers
-    hash with the same vocabulary)."""
+    buffer with the same bytes cannot collide; ``namespace`` optionally
+    folds a model fingerprint into the digest itself (the pre-fleet
+    spelling — the servers now pass the namespace to the cache
+    explicitly so it stays evictable by route). Delegates to the shared
+    encoding in core/hashing.py (the store and checkpoint layers hash
+    with the same vocabulary)."""
     return array_digest(genotypes, namespace=namespace)
 
 
 class ResultCache:
-    """Thread-safe bounded LRU: get/put under one lock.
+    """Thread-safe bounded LRU with namespace-indexed entries.
 
-    Stored arrays are marked read-only and returned as-is (the server
-    copies on the way out only if a caller asks to mutate); capacity 0
+    Keys are ``(namespace, digest)`` pairs: the namespace carries the
+    serving model's fingerprint, so equal queries against different
+    models (fleet routes, pre/post hot-reload) can never collide, and a
+    whole namespace is evictable in one call when its route unloads.
+    Stored arrays are copied in and marked read-only; capacity 0
     disables storage entirely (every get misses)."""
 
     def __init__(self, capacity: int):
         self.capacity = max(0, int(capacity))
-        self._data: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._data: OrderedDict[tuple[str, str], np.ndarray] = OrderedDict()
+        self._bytes = 0
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._data)
 
-    def get(self, key: str) -> np.ndarray | None:
+    def get(self, key: str, namespace: str = "") -> np.ndarray | None:
         with self._lock:
-            value = self._data.get(key)
+            value = self._data.get((namespace, key))
             if value is not None:
-                self._data.move_to_end(key)
+                self._data.move_to_end((namespace, key))
             return value
 
-    def put(self, key: str, value: np.ndarray) -> None:
+    def put(self, key: str, value: np.ndarray,
+            namespace: str = "") -> None:
         if self.capacity == 0:
             return
         # A genuine copy, not ascontiguousarray: freezing an alias of
@@ -61,11 +78,36 @@ class ResultCache:
         frozen = np.array(value)
         frozen.setflags(write=False)
         with self._lock:
-            self._data[key] = frozen
-            self._data.move_to_end(key)
+            old = self._data.get((namespace, key))
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._data[(namespace, key)] = frozen
+            self._bytes += frozen.nbytes
+            self._data.move_to_end((namespace, key))
             while len(self._data) > self.capacity:
-                self._data.popitem(last=False)
+                _, evicted = self._data.popitem(last=False)
+                self._bytes -= evicted.nbytes
+
+    def evict_namespace(self, namespace: str) -> int:
+        """Drop every entry of ``namespace`` (a route's whole cache
+        footprint on unload); returns the count evicted."""
+        with self._lock:
+            doomed = [k for k in self._data if k[0] == namespace]
+            for k in doomed:
+                self._bytes -= self._data.pop(k).nbytes
+            return len(doomed)
+
+    def stats(self) -> dict:
+        """Entry/byte accounting (the lifecycle contract: bytes return
+        to baseline after every namespace eviction)."""
+        with self._lock:
+            return {
+                "entries": len(self._data),
+                "bytes": int(self._bytes),
+                "namespaces": len({k[0] for k in self._data}),
+            }
 
     def clear(self) -> None:
         with self._lock:
             self._data.clear()
+            self._bytes = 0

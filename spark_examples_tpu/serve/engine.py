@@ -42,6 +42,106 @@ from spark_examples_tpu.pipelines import project as P
 from spark_examples_tpu.serve.health import CircuitBreaker
 
 
+class ModelContext:
+    """A loaded, validated model installed on device: the projectable
+    stats, the f32-cast eigen/centering statistics, and the per-row
+    finalize. ONE implementation shared by the single-model
+    :class:`ProjectionEngine` and the fleet's per-route serving path
+    (serve/router.py), so served bit-identity with the offline CLI has
+    a single anchor instead of two copies that could drift."""
+
+    def __init__(self, model):
+        if isinstance(model, (str, bytes)):
+            model = P.load_model(model)
+        self.stats = P.check_projectable(model)
+        self.model = model
+        # f32 casts at the device boundary — exactly what the offline
+        # path does with the freshly np.load-ed f64 arrays.
+        self._eigvecs = jax.device_put(
+            np.asarray(model.eigvecs, np.float32))
+        self._eigvals = jax.device_put(
+            np.asarray(model.eigvals, np.float32))
+        self._colmean = jax.device_put(
+            np.asarray(model.colmean, np.float32))
+        self._grand = jnp.float32(model.grand)
+
+    @property
+    def n_ref(self) -> int:
+        return self.model.n_ref
+
+    @property
+    def n_components(self) -> int:
+        return self.model.n_components
+
+    def finalize_row(self, acc, i: int):
+        """One live row at shape (1, N_ref) through the SAME compiled
+        finalize as the offline single-query path — the bit-identity
+        anchor."""
+        if self.model.kind == "pca":
+            return P._project_pca(
+                acc["s"][i:i + 1], self._colmean, self._grand,
+                self._eigvecs,
+            )
+        return P._project(
+            {k: v[i:i + 1] for k, v in acc.items()}, self._colmean,
+            self._grand, self._eigvecs, self._eigvals,
+            metric=self.model.metric,
+        )
+
+
+def batch_coords(ctx: ModelContext, ref_blocks, genotypes: np.ndarray,
+                 max_batch: int, n_variants: int) -> np.ndarray:
+    """(b, V) int8 query genotypes -> (b, k) f32 coordinates through
+    the padded-batch serving math: hom-ref padding to ``max_batch`` (one
+    jit entry per staged block width serves every batch size), int32
+    cross statistics against the staged reference blocks, the per-row
+    finalize at (1, N_ref). Bit-identical per row to the offline
+    single-query ``pcoa_project_job`` (module docstring)."""
+    g = np.ascontiguousarray(genotypes, dtype=np.int8)
+    if g.ndim != 2 or g.shape[1] != n_variants:
+        raise ValueError(
+            f"query batch must be (b, {n_variants}) int8 dosages, "
+            f"got {g.shape}"
+        )
+    b = g.shape[0]
+    if not 1 <= b <= max_batch:
+        raise ValueError(
+            f"batch of {b} rows outside [1, {max_batch}]"
+        )
+    if b < max_batch:
+        # Hom-ref padding rows: any valid dosage works — their
+        # accumulator rows are computed and never read.
+        g = np.concatenate(
+            [g, np.zeros((max_batch - b, n_variants), np.int8)], axis=0)
+    acc = {
+        k: jnp.zeros((max_batch, ctx.n_ref), jnp.int32)
+        for k in ctx.stats
+    }
+    for ref_dev, meta in ref_blocks:
+        q = jax.device_put(
+            np.ascontiguousarray(g[:, meta.start:meta.stop]))
+        acc = P._update_cross(acc, q, ref_dev)
+    rows = [np.asarray(ctx.finalize_row(acc, i)) for i in range(b)]
+    return np.concatenate(rows, axis=0)
+
+
+def stage_blocks(source_ref, block_variants: int) -> tuple[list, int, int]:
+    """Stage a reference panel's dense int8 blocks device-resident:
+    ``(blocks, n_variants, nbytes)``. Shared by the engine's startup
+    staging and the fleet warm pool (serve/pool.py) — the byte count is
+    what the pool's budget charges."""
+    blocks = []
+    n_variants = 0
+    nbytes = 0
+    for block, meta in source_ref.blocks(block_variants):
+        blocks.append((jax.device_put(block), meta))
+        n_variants = meta.stop
+        nbytes += int(block.nbytes)
+    if n_variants == 0:
+        raise ValueError("reference source yielded no variants")
+    return blocks, n_variants, nbytes
+
+
 def _store_cache_of(source):
     """The DecodeCache behind a (possibly wrapped) store-backed source,
     or None — serve's /stats endpoint reports its accounting when the
@@ -103,13 +203,8 @@ class ProjectionEngine:
             self.warmup()
 
     def _stage_panel(self, source_ref) -> tuple[list, int]:
-        blocks = []
-        n_variants = 0
-        for block, meta in source_ref.blocks(self.block_variants):
-            blocks.append((jax.device_put(block), meta))
-            n_variants = meta.stop
-        if n_variants == 0:
-            raise ValueError("reference source yielded no variants")
+        blocks, n_variants, _nbytes = stage_blocks(
+            source_ref, self.block_variants)
         return blocks, n_variants
 
     def restage(self, source_ref=None) -> bool:
@@ -174,18 +269,16 @@ class ProjectionEngine:
 
     def _install_model(self, model: "P.ProjectionModel") -> None:
         """Validate + move a model's statistics to device (init and
-        hot-reload share this)."""
-        self.stats = P.check_projectable(model)
-        self.model = model
-        # f32 casts at the device boundary — exactly what the offline
-        # path does with the freshly np.load-ed f64 arrays.
-        self._eigvecs = jax.device_put(
-            np.asarray(model.eigvecs, np.float32))
-        self._eigvals = jax.device_put(
-            np.asarray(model.eigvals, np.float32))
-        self._colmean = jax.device_put(
-            np.asarray(model.colmean, np.float32))
-        self._grand = jnp.float32(model.grand)
+        hot-reload share this) — one :class:`ModelContext`."""
+        self._ctx = ModelContext(model)
+
+    @property
+    def model(self):
+        return self._ctx.model
+
+    @property
+    def stats(self) -> tuple[str, ...]:
+        return self._ctx.stats
 
     def store_cache_stats(self) -> dict | None:
         """DecodeCache accounting of the staged panel's store (hits/
@@ -226,60 +319,20 @@ class ProjectionEngine:
                 "different reference panel than the one staged on "
                 "device — restart the server against the right panel"
             )
-        old = (self.model, self.stats, self._eigvecs, self._eigvals,
-               self._colmean, self._grand)
+        old_ctx = self._ctx
         P.clear_caches()
         try:
             self._install_model(model)
             self.warmup()
         except BaseException:
-            (self.model, self.stats, self._eigvecs, self._eigvals,
-             self._colmean, self._grand) = old
+            self._ctx = old_ctx
             raise
 
     def project_batch(self, genotypes: np.ndarray) -> np.ndarray:
         """(b, V) int8 query genotypes -> (b, k) f32 coordinates,
         b <= max_batch. Bit-identical per row to the offline
-        single-query ``pcoa_project_job`` (see module docstring)."""
-        g = np.ascontiguousarray(genotypes, dtype=np.int8)
-        if g.ndim != 2 or g.shape[1] != self.n_variants:
-            raise ValueError(
-                f"query batch must be (b, {self.n_variants}) int8 "
-                f"dosages, got {g.shape}"
-            )
-        b = g.shape[0]
-        if not 1 <= b <= self.max_batch:
-            raise ValueError(
-                f"batch of {b} rows outside [1, {self.max_batch}]"
-            )
-        if b < self.max_batch:
-            # Hom-ref padding rows: any valid dosage works — their
-            # accumulator rows are computed and never read.
-            g = np.concatenate(
-                [g, np.zeros((self.max_batch - b, self.n_variants),
-                             np.int8)], axis=0)
-        acc = {
-            k: jnp.zeros((self.max_batch, self.n_ref), jnp.int32)
-            for k in self.stats
-        }
-        for ref_dev, meta in self._ref_blocks:
-            q = jax.device_put(
-                np.ascontiguousarray(g[:, meta.start:meta.stop]))
-            acc = P._update_cross(acc, q, ref_dev)
-        rows = [np.asarray(self._finalize_row(acc, i)) for i in range(b)]
-        return np.concatenate(rows, axis=0)
-
-    def _finalize_row(self, acc, i: int):
-        """One live row at shape (1, N_ref) through the SAME compiled
-        finalize as the offline single-query path — the bit-identity
-        anchor."""
-        if self.model.kind == "pca":
-            return P._project_pca(
-                acc["s"][i:i + 1], self._colmean, self._grand,
-                self._eigvecs,
-            )
-        return P._project(
-            {k: v[i:i + 1] for k, v in acc.items()}, self._colmean,
-            self._grand, self._eigvecs, self._eigvals,
-            metric=self.model.metric,
-        )
+        single-query ``pcoa_project_job`` (see module docstring) —
+        the math lives in :func:`batch_coords`, shared with the fleet
+        serving path."""
+        return batch_coords(self._ctx, self._ref_blocks, genotypes,
+                            self.max_batch, self.n_variants)

@@ -47,6 +47,17 @@ def publish(state: str) -> None:
     telemetry.gauge_set("serve.health", float(HEALTH_CODE[state]))
 
 
+def worst(states) -> str:
+    """The most severe of several member states — the fleet's health
+    fold: one route serving cached-only (breaker open) degrades the
+    whole process's /healthz, because a load balancer can only see the
+    process. Empty input is healthy."""
+    states = list(states)
+    if not states:
+        return HEALTHY
+    return max(states, key=HEALTH_CODE.__getitem__)
+
+
 class CircuitBreaker:
     """Three-state breaker: closed -> (trip_after consecutive
     failures) -> open -> (reset_s elapsed) -> half-open probe ->

@@ -39,6 +39,26 @@ from spark_examples_tpu.serve.server import (
 )
 
 
+def _parse_project_body(handler) -> tuple[np.ndarray, float | None, dict]:
+    """Shared POST /project body decoding: (genotypes, deadline_s, raw
+    request dict). Raises the body's problem for the caller's 400."""
+    length = int(handler.headers.get("Content-Length", "0"))
+    req = json.loads(handler.rfile.read(length) or b"{}")
+    raw = np.asarray(req["genotypes"])
+    if raw.dtype.kind not in "iu":
+        raise ValueError(
+            f"genotypes must be integer dosages (got {raw.dtype} values)")
+    # dtype= on the original list (not .astype, which wraps silently):
+    # an out-of-int8-range dosage raises here and becomes a 400, never
+    # a dropped socket.
+    genotypes = np.asarray(req["genotypes"], dtype=np.int8)
+    deadline_ms = req.get("deadline_ms")
+    # Converted HERE so a non-numeric deadline is a 400 (client error),
+    # not a 500 from deep in the submit.
+    deadline_s = float(deadline_ms) / 1e3 if deadline_ms else None
+    return genotypes, deadline_s, req
+
+
 def _make_handler(pserver: ProjectionServer):
     class Handler(BaseHTTPRequestHandler):
         # Silence the default per-request stderr lines (telemetry is the
@@ -83,22 +103,7 @@ def _make_handler(pserver: ProjectionServer):
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
                 return
             try:
-                length = int(self.headers.get("Content-Length", "0"))
-                req = json.loads(self.rfile.read(length) or b"{}")
-                raw = np.asarray(req["genotypes"])
-                if raw.dtype.kind not in "iu":
-                    raise ValueError(
-                        "genotypes must be integer dosages "
-                        f"(got {raw.dtype} values)")
-                # dtype= on the original list (not .astype, which wraps
-                # silently): an out-of-int8-range dosage raises here and
-                # becomes a 400, never a dropped socket.
-                genotypes = np.asarray(req["genotypes"], dtype=np.int8)
-                deadline_ms = req.get("deadline_ms")
-                # Converted HERE so a non-numeric deadline is a 400
-                # (client error), not a 500 from deep in the submit.
-                deadline_s = (
-                    float(deadline_ms) / 1e3 if deadline_ms else None)
+                genotypes, deadline_s, _req = _parse_project_body(self)
             except (ValueError, KeyError, TypeError, OverflowError) as e:
                 self._reply(400, {"error": f"bad request body: {e}"})
                 return
@@ -120,14 +125,105 @@ def _make_handler(pserver: ProjectionServer):
     return Handler
 
 
+def _make_fleet_handler(fleet):
+    """The fleet front (serve --fleet): same endpoints as the
+    single-model handler plus route addressing — ``POST /project``
+    takes ``route`` (and optional ``priority``) in the body, or the
+    route rides the path as ``POST /project/<route>``; ``GET /routes``
+    lists the registry with per-route stats."""
+    from spark_examples_tpu.serve.pool import PanelUnavailable
+    from spark_examples_tpu.serve.router import UnknownRoute
+
+    class FleetHandler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path == "/healthz":
+                self._reply(200, fleet.health_info())
+                return
+            if self.path == "/stats":
+                self._reply(200, fleet.stats_payload())
+                return
+            if self.path == "/routes":
+                self._reply(200, fleet.stats_payload()["routes"])
+                return
+            if self.path == "/metrics":
+                # Autoscale gauges recomputed at scrape time: the
+                # per-route series an autoscaler reads must be current,
+                # not last-batch-stale.
+                fleet.publish_autoscale()
+                live_view.reply_metrics(self)
+                return
+            if self.path == "/debug/telemetry":
+                live_view.reply_debug_telemetry(self)
+                return
+            self._reply(404, {"error": f"unknown path {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802 (stdlib API)
+            if not (self.path == "/project"
+                    or self.path.startswith("/project/")):
+                self._reply(404, {"error": f"unknown path {self.path!r}"})
+                return
+            try:
+                genotypes, deadline_s, req = _parse_project_body(self)
+                route = (self.path[len("/project/"):]
+                         if self.path.startswith("/project/")
+                         else req.get("route"))
+                if not route:
+                    raise ValueError(
+                        "fleet request names no route (body 'route' "
+                        "field or POST /project/<route>)")
+                kwargs = {}
+                if req.get("priority") is not None:
+                    kwargs["priority"] = str(req["priority"])
+            except (ValueError, KeyError, TypeError, OverflowError) as e:
+                self._reply(400, {"error": f"bad request body: {e}"})
+                return
+            try:
+                coords = fleet.project(route, genotypes,
+                                       deadline_s=deadline_s, **kwargs)
+            except UnknownRoute as e:
+                self._reply(404, {"error": str(e)})
+            except ServerOverloaded as e:
+                self._reply(429, {"error": str(e)})
+            except DeadlineExceeded as e:
+                self._reply(504, {"error": str(e)})
+            except ServerClosed as e:
+                self._reply(503, {"error": str(e)})
+            except PanelUnavailable as e:
+                # The route's panel cannot stage right now (breaker
+                # open / store down) — unavailable, not a client error.
+                self._reply(503, {"error": str(e)})
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # answered, never a dropped socket
+                self._reply(500, {"error": repr(e)})
+            else:
+                self._reply(200, {"coords": coords.tolist()})
+
+    return FleetHandler
+
+
 class ProjectionHTTPServer:
     """Lifecycle wrapper: bind (port 0 = ephemeral), serve in a daemon
-    thread or in the foreground, shut down idempotently."""
+    thread or in the foreground, shut down idempotently. ``handler``
+    overrides the single-model handler (the fleet front passes its
+    own)."""
 
-    def __init__(self, pserver: ProjectionServer,
-                 host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, pserver: ProjectionServer | None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 handler=None):
         self._httpd = ThreadingHTTPServer(
-            (host, port), _make_handler(pserver))
+            (host, port), handler or _make_handler(pserver))
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: threading.Thread | None = None
@@ -154,3 +250,17 @@ def start_http_server(pserver: ProjectionServer, host: str = "127.0.0.1",
     """Bind + serve in a background thread; returns the wrapper (read
     ``.port`` for the ephemeral bind)."""
     return ProjectionHTTPServer(pserver, host=host, port=port).serve_in_thread()
+
+
+def fleet_http_server(fleet, host: str = "127.0.0.1",
+                      port: int = 0) -> ProjectionHTTPServer:
+    """The fleet front, not yet serving (call ``serve_forever`` or
+    ``serve_in_thread``)."""
+    return ProjectionHTTPServer(None, host=host, port=port,
+                                handler=_make_fleet_handler(fleet))
+
+
+def start_fleet_http_server(fleet, host: str = "127.0.0.1",
+                            port: int = 0) -> ProjectionHTTPServer:
+    """Bind the fleet front + serve in a background thread."""
+    return fleet_http_server(fleet, host=host, port=port).serve_in_thread()

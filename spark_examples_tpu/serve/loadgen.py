@@ -1,4 +1,5 @@
-"""Closed-loop load generator for the projection server.
+"""Closed-loop load generators: single-model, multi-tenant fleet mix,
+and replica hedging.
 
 ``clients`` threads each submit queries back-to-back (a new request the
 moment the previous one resolves — classic closed-loop load), drawing
@@ -10,17 +11,36 @@ diverge and the gap is the shed/error count, never silent queueing.
 Latency percentiles are read from the telemetry registry's
 ``serve.latency_s`` histogram — the same numbers ``--telemetry-dir``
 exports — so the report and the export cannot disagree.
+
+Fleet additions:
+
+- :func:`run_fleet_loadgen` — a multi-tenant traffic mix over a
+  :class:`~spark_examples_tpu.serve.router.FleetRouter`: each mix entry
+  is (route, priority class, clients), latencies tracked client-side
+  per (route, class), and the report carries the per-class aggregate
+  p50/p99 the priority contract is judged on (interactive p99 below
+  batch p99 under mixed load).
+- :func:`run_hedged_loadgen` — client-side request hedging between
+  replica processes sharing the content-addressed store as their cold
+  tier: a client sends to its primary, waits a **p95-derived hedge
+  delay** (the rolling p95 of its own completed primaries; the classic
+  tail-at-scale recipe), then sends the same query to a second replica
+  — first answer wins, the loser is cancelled. ``fleet.hedge_launched``
+  / ``fleet.hedge_wins`` count the relief.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
+from concurrent.futures import FIRST_COMPLETED, wait
 from dataclasses import dataclass
 
 import numpy as np
 
 from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core.config import DEFAULT_PRIORITY
 from spark_examples_tpu.serve.server import (
     DeadlineExceeded,
     ProjectionServer,
@@ -105,4 +125,282 @@ def run_loadgen(server: ProjectionServer, pool: np.ndarray,
         "latency_p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
         "latency_max_ms": round(lat.get("max", 0.0) * 1e3, 3),
         "server": server.stats.snapshot(),
+    }
+
+
+# --------------------------------------------------------------- fleet mix
+
+
+def run_fleet_loadgen(fleet, pools: dict[str, np.ndarray],
+                      mix: list[tuple[str, str, int]],
+                      requests_per_client: int = 50,
+                      deadline_s: float | None = None,
+                      result_timeout_s: float = 60.0) -> dict:
+    """Multi-tenant closed-loop mix against a fleet router.
+
+    ``pools`` maps route name -> (Q, V_route) int8 query pool; ``mix``
+    is the tenant table — one ``(route, priority_class, clients)``
+    entry per traffic source. Latencies are measured CLIENT-side per
+    (route, class) so the per-class percentiles include queueing (the
+    thing priorities exist to shape), and the report's
+    ``p99_interactive_s`` / ``p99_batch_s`` pair is the priority
+    contract's acceptance number."""
+    tenants = []  # (route, cls, tally, hist) per client thread
+    for route, cls, clients in mix:
+        if route not in pools:
+            raise ValueError(
+                f"mix names route {route!r} but pools has no query "
+                f"pool for it (pools: {sorted(pools)})"
+            )
+        for _ in range(max(0, int(clients))):
+            tenants.append((route, cls, _ClientTally(),
+                            telemetry.Histogram()))
+    if not tenants:
+        raise ValueError("empty mix — nothing to offer")
+    start = threading.Barrier(len(tenants) + 1)
+
+    def client(idx: int) -> None:
+        route, cls, tally, hist = tenants[idx]
+        pool = pools[route]
+        stride = max(1, len(tenants))
+        start.wait()
+        for k in range(requests_per_client):
+            q = pool[(idx + k * stride) % len(pool)]
+            tally.attempts += 1
+            t0 = time.perf_counter()
+            try:
+                fleet.project(route, q, timeout=result_timeout_s,
+                              priority=cls, deadline_s=deadline_s)
+                tally.ok += 1
+                hist.record(time.perf_counter() - t0)
+            except ServerOverloaded:
+                tally.shed += 1
+            except DeadlineExceeded:
+                tally.deadline += 1
+            except Exception:
+                tally.errors += 1
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True,
+                         name=f"loadgen-client-{i}")
+        for i in range(len(tenants))
+    ]
+    for t in threads:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    duration = max(time.perf_counter() - t0, 1e-9)
+
+    def _merge(selector) -> telemetry.Histogram:
+        merged = telemetry.Histogram()
+        for route, cls, _tally, hist in tenants:
+            if selector(route, cls):
+                merged.merge(hist)
+        return merged
+
+    per_class = {}
+    for cls in sorted({c for _r, c, _t, _h in tenants}):
+        h = _merge(lambda _r, c, cls=cls: c == cls)
+        tallies = [t for _r, c, t, _h in tenants if c == cls]
+        per_class[cls] = {
+            "clients": len(tallies),
+            "completed": sum(t.ok for t in tallies),
+            "shed": sum(t.shed for t in tallies),
+            "deadline_expired": sum(t.deadline for t in tallies),
+            "errors": sum(t.errors for t in tallies),
+            "p50_s": round(h.quantile(0.5), 6),
+            "p99_s": round(h.quantile(0.99), 6),
+        }
+    per_route = {}
+    for route in sorted({r for r, _c, _t, _h in tenants}):
+        h = _merge(lambda r, _c, route=route: r == route)
+        tallies = [t for r, _c, t, _h in tenants if r == route]
+        per_route[route] = {
+            "completed": sum(t.ok for t in tallies),
+            "shed": sum(t.shed for t in tallies),
+            "errors": sum(t.errors for t in tallies),
+            "p99_s": round(h.quantile(0.99), 6),
+        }
+    attempts = sum(t.attempts for _r, _c, t, _h in tenants)
+    ok = sum(t.ok for _r, _c, t, _h in tenants)
+    return {
+        "clients": len(tenants),
+        "requests_per_client": requests_per_client,
+        "duration_s": round(duration, 4),
+        "offered_qps": round(attempts / duration, 2),
+        "sustained_qps": round(ok / duration, 2),
+        "completed": ok,
+        "shed": sum(t.shed for _r, _c, t, _h in tenants),
+        "errors": sum(t.errors for _r, _c, t, _h in tenants),
+        "per_class": per_class,
+        "per_route": per_route,
+    }
+
+
+# ---------------------------------------------------------------- hedging
+
+
+class _HedgeDelay:
+    """Rolling p95 of completed primary latencies (shared by all
+    clients of one hedged run) — the hedge trigger. Until enough
+    samples exist the caller's floor delay applies."""
+
+    def __init__(self, floor_s: float, window: int = 256,
+                 min_samples: int = 20):
+        self.floor_s = float(floor_s)
+        self._ring: deque[float] = deque(maxlen=window)
+        self._min = int(min_samples)
+        self._lock = threading.Lock()
+
+    def record(self, latency_s: float) -> None:
+        with self._lock:
+            self._ring.append(latency_s)
+
+    def delay_s(self) -> float:
+        with self._lock:
+            if len(self._ring) < self._min:
+                return self.floor_s
+            ordered = sorted(self._ring)
+            p95 = ordered[min(len(ordered) - 1,
+                              int(0.95 * len(ordered)))]
+        return max(self.floor_s, p95)
+
+
+def run_hedged_loadgen(replicas, pool: np.ndarray,
+                       clients: int = 4, requests_per_client: int = 50,
+                       route: str | None = None,
+                       priority: str = DEFAULT_PRIORITY,
+                       hedge_floor_s: float = 0.01,
+                       deadline_s: float | None = None,
+                       result_timeout_s: float = 60.0) -> dict:
+    """Closed-loop load with client-side request hedging between two
+    (or more) replicas. ``replicas[0]`` is every client's primary; a
+    request unanswered after the p95-derived hedge delay is re-sent to
+    the next replica round-robin — first answer wins, the loser future
+    is cancelled (a queued loser is dropped at batch pickup; one
+    already running finishes and is ignored). ``route`` switches the
+    submit surface to the fleet router's; None drives single-model
+    ProjectionServers.
+
+    Replica processes share the content-addressed store as their cold
+    tier, so a hedge landing on a cold replica pays at worst one
+    re-stage — which is exactly the tail the hedge exists to cut."""
+    if len(replicas) < 2:
+        raise ValueError("hedging needs >= 2 replicas")
+    pool = np.ascontiguousarray(pool, dtype=np.int8)
+
+    def _submit(replica, q):
+        if route is None:
+            return replica.submit(q, deadline_s=deadline_s)
+        return replica.submit(route, q, priority=priority,
+                              deadline_s=deadline_s)
+
+    tallies = [_ClientTally() for _ in range(clients)]
+    hists = [telemetry.Histogram() for _ in range(clients)]
+    hedges = [[0, 0] for _ in range(clients)]  # [launched, wins]
+    delay = _HedgeDelay(hedge_floor_s)
+    start = threading.Barrier(clients + 1)
+
+    def client(c: int) -> None:
+        tally, hist = tallies[c], hists[c]
+        start.wait()
+        for k in range(requests_per_client):
+            q = pool[(c + k * clients) % len(pool)]
+            tally.attempts += 1
+            t0 = time.perf_counter()
+            try:
+                primary = _submit(replicas[0], q)
+            except Exception:
+                tally.errors += 1
+                continue
+            hedge_after = delay.delay_s()
+            try:
+                primary.result(timeout=hedge_after)
+                dt = time.perf_counter() - t0
+                tally.ok += 1
+                hist.record(dt)
+                delay.record(dt)
+                continue
+            except Exception:
+                # done-with-exception = a real failure (shed, deadline,
+                # fault) — NOT a hedge trigger. Only an unanswered
+                # primary past the delay hedges (the wait timed out and
+                # the future is still pending/running).
+                if primary.done():
+                    tally.errors += 1
+                    continue
+            # Primary is the straggler: hedge to the next replica.
+            hedges[c][0] += 1
+            telemetry.count("fleet.hedge_launched")
+            backup = replicas[1 + (c % (len(replicas) - 1))]
+            try:
+                hedge = _submit(backup, q)
+            except Exception:
+                hedge = None
+            futs = [f for f in (primary, hedge) if f is not None]
+            done, _pending = wait(futs, timeout=result_timeout_s,
+                                  return_when=FIRST_COMPLETED)
+            # wait(FIRST_COMPLETED) returns EVERY future already done,
+            # not just the first — when both landed in the window,
+            # crediting the hedge would inflate the win rate, so the
+            # primary takes attribution ties (wins are undercounted,
+            # never overcounted).
+            winner = None
+            if primary in done:
+                winner = primary
+            elif hedge is not None and hedge in done:
+                winner = hedge
+            if winner is None:
+                tally.errors += 1
+                continue
+            loser = primary if winner is hedge else hedge
+            if loser is not None:
+                loser.cancel()  # queued loser drops at pickup
+            try:
+                winner.result(timeout=result_timeout_s)
+            except Exception:
+                tally.errors += 1
+                continue
+            if winner is hedge:
+                hedges[c][1] += 1
+                telemetry.count("fleet.hedge_wins")
+            dt = time.perf_counter() - t0
+            tally.ok += 1
+            hist.record(dt)
+            # The hedged request's end-to-end latency feeds the p95 too
+            # — a systematically slow primary keeps the trigger honest.
+            delay.record(dt)
+
+    threads = [
+        threading.Thread(target=client, args=(c,), daemon=True,
+                         name=f"loadgen-client-{c}")
+        for c in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    duration = max(time.perf_counter() - t0, 1e-9)
+    merged = telemetry.Histogram()
+    for h in hists:
+        merged.merge(h)
+    launched = sum(h[0] for h in hedges)
+    wins = sum(h[1] for h in hedges)
+    ok = sum(t.ok for t in tallies)
+    return {
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "duration_s": round(duration, 4),
+        "completed": ok,
+        "errors": sum(t.errors for t in tallies),
+        "sustained_qps": round(ok / duration, 2),
+        "hedge_launched": launched,
+        "hedge_wins": wins,
+        "hedge_win_frac": round(wins / launched, 4) if launched else 0.0,
+        "p50_s": round(merged.quantile(0.5), 6),
+        "p99_s": round(merged.quantile(0.99), 6),
     }

@@ -392,8 +392,8 @@ class ProjectionServer:
         t0 = time.perf_counter()
         digest = None
         if self._cache.capacity:
-            digest = genotype_digest(g, namespace=self._cache_ns)
-            hit = self._cache.get(digest)
+            digest = genotype_digest(g)
+            hit = self._cache.get(digest, namespace=self._cache_ns)
             if hit is not None:
                 telemetry.count("serve.cache_hits")
                 telemetry.observe("serve.latency_s",
@@ -564,7 +564,12 @@ class ProjectionServer:
         for p, row in zip(live, coords):
             result = row[None, :]
             if p.digest is not None:
-                self._cache.put(p.digest, result)
+                # Namespace read HERE, not at submit: a request that
+                # raced a hot-reload was computed by the NEW model
+                # (behind the engine lock), so its row must land under
+                # the new namespace.
+                self._cache.put(p.digest, result,
+                                namespace=self._cache_ns)
             p.future.set_result(result)
             telemetry.observe("serve.latency_s", now - p.t_submit)
             with self.stats.lock:

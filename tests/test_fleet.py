@@ -1,0 +1,691 @@
+"""Fleet serving (serve/fleet.py + pool.py + router.py): manifest
+validation, 3-route bit-identity against the single-model server AND
+the offline `project` CLI (including immediately after an LRU eviction
++ re-stage), the HBM-budgeted warm pool, priority-class admission
+(interactive preempts batch; per-class sheds and deadlines), the
+fleet.stage fault site + route circuit breaker, result-cache namespace
+lifecycle on route unload, client-side replica hedging, the fleet HTTP
+front, and the `serve --fleet` CLI.
+
+The acceptance test (`test_acceptance_multi_tenant_mix`) is the tier-1
+smoke of ISSUE 15's contract: a 3-route fleet under the multi-tenant
+loadgen mix serves every route bit-identically while the pool stays
+under budget with evictions observed, interactive p99 below batch p99,
+and no quarantine entries.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.core.config import (
+    PRIORITY_CLASSES,
+    ComputeConfig,
+    IngestConfig,
+    JobConfig,
+    ServeConfig,
+)
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.pipelines.jobs import pcoa_job, variants_pca_job
+from spark_examples_tpu.pipelines.project import pcoa_project_job
+from spark_examples_tpu.serve import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    FleetFormatError,
+    FleetManifest,
+    PanelPool,
+    PanelUnavailable,
+    ProjectionEngine,
+    ProjectionServer,
+    ServerOverloaded,
+    UnknownRoute,
+    build_fleet,
+    run_fleet_loadgen,
+    run_hedged_loadgen,
+)
+from spark_examples_tpu.store import quarantine as qledger
+from tests.conftest import random_genotypes
+
+BV = 128  # staging/fit block width for every test panel
+N, V = 12, 256
+PANEL_BYTES = N * V  # dense int8 staged bytes per test panel
+
+INTERACTIVE, BATCH = PRIORITY_CLASSES
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure(dir=None)
+
+
+@pytest.fixture(scope="module")
+def fx(tmp_path_factory):
+    """Three fitted (model, store-backed panel) routes — ibs PCoA,
+    shared-alt PCA, jaccard PCoA — plus their offline ground truths."""
+    from spark_examples_tpu.store.writer import compact
+
+    base = tmp_path_factory.mktemp("fleet_fixture")
+    rng = np.random.default_rng(42)
+    routes = {}
+    for i, (name, kind, metric) in enumerate([
+        ("r-ibs", "pcoa", "ibs"),
+        ("r-pca", "pca", None),
+        ("r-jac", "pcoa", "jaccard"),
+    ]):
+        g = random_genotypes(rng, n=N, v=V, missing_rate=0.1)
+        store = str(base / f"store_{i}")
+        compact(store, ArraySource(g), chunk_variants=64)
+        model = str(base / f"model_{i}.npz")
+        job = JobConfig(
+            ingest=IngestConfig(block_variants=BV),
+            compute=ComputeConfig(metric=metric, num_pc=3),
+            model_path=model,
+        )
+        (pcoa_job if kind == "pcoa" else variants_pca_job)(
+            job, source=ArraySource(g))
+        routes[name] = SimpleNamespace(
+            name=name, genotypes=g, store=store, model=model, job=job)
+    return SimpleNamespace(base=base, routes=routes)
+
+
+def _manifest_doc(fx, **top) -> dict:
+    return {
+        "routes": [
+            {"name": r.name, "model": r.model,
+             "source": f"store:{r.store}"}
+            for r in fx.routes.values()
+        ],
+        **top,
+    }
+
+
+def _build(fx, budget_mb=1.0, cfg=None, readahead=0, **manifest_top):
+    manifest = FleetManifest.parse(
+        _manifest_doc(fx, budget_mb=budget_mb, **manifest_top))
+    return build_fleet(
+        manifest, cfg or ServeConfig(),
+        ingest_defaults=IngestConfig(block_variants=BV,
+                                     readahead_chunks=readahead),
+    )
+
+
+def _offline(route, query) -> np.ndarray:
+    """The offline single-query `project` path — the serving
+    contract's ground truth."""
+    return pcoa_project_job(
+        route.job.replace(model_path=None), model_path=route.model,
+        source_new=ArraySource(
+            query[None, :] if query.ndim == 1 else query),
+        source_ref=ArraySource(route.genotypes),
+    ).coords
+
+
+# ----------------------------------------------------------- manifest
+
+
+def test_manifest_validation_names_the_problem(tmp_path):
+    with pytest.raises(FleetFormatError, match="routes"):
+        FleetManifest.parse({"routes": []})
+    with pytest.raises(FleetFormatError, match="'model'"):
+        FleetManifest.parse(
+            {"routes": [{"name": "a", "source": "synthetic"}]})
+    with pytest.raises(FleetFormatError, match="duplicate route"):
+        FleetManifest.parse({"routes": [
+            {"name": "a", "model": "m.npz", "source": "synthetic"},
+            {"name": "a", "model": "m2.npz", "source": "synthetic"},
+        ]})
+    with pytest.raises(FleetFormatError, match="unknown field"):
+        FleetManifest.parse({"routes": [
+            {"name": "a", "model": "m.npz", "source": "synthetic",
+             "modle": "typo"},
+        ]})
+    with pytest.raises(FleetFormatError, match="unknown top-level"):
+        FleetManifest.parse(_manifest_doc_empty(), )
+    p = tmp_path / "broken.json"
+    p.write_text("{not json")
+    with pytest.raises(FleetFormatError, match="not readable JSON"):
+        FleetManifest.load(str(p))
+    # Scalar fields are type-checked at parse: a string budget (or a
+    # bool, or a sub-1 max_batch) is a named FleetFormatError, never a
+    # TypeError from deep inside pool construction.
+    good_routes = [{"name": "a", "model": "m", "source": "s"}]
+    with pytest.raises(FleetFormatError, match="budget_mb"):
+        FleetManifest.parse({"routes": good_routes, "budget_mb": "256"})
+    with pytest.raises(FleetFormatError, match="max_batch"):
+        FleetManifest.parse({"routes": good_routes, "max_batch": True})
+    with pytest.raises(FleetFormatError, match="block_variants"):
+        FleetManifest.parse({"routes": [
+            {"name": "a", "model": "m", "source": "s",
+             "block_variants": "4096"}]})
+
+
+def _manifest_doc_empty():
+    return {"routes": [{"name": "a", "model": "m", "source": "s"}],
+            "budget_gb": 1}
+
+
+# ------------------------------------------------- bit-identity (tier-1)
+
+
+def test_three_routes_bit_identical_to_single_model_and_offline(fx):
+    """Every route's served coordinates equal BOTH its own single-model
+    ProjectionServer's and the offline `project` CLI's, bit for bit."""
+    fleet = _build(fx, budget_mb=1.0).start()
+    rng = np.random.default_rng(7)
+    try:
+        for route in fx.routes.values():
+            q = random_genotypes(rng, n=1, v=V, missing_rate=0.1)[0]
+            offline = _offline(route, q)
+            got = fleet.project(route.name, q, timeout=60)
+            np.testing.assert_array_equal(got, offline)
+            engine = ProjectionEngine(
+                route.model, ArraySource(route.genotypes),
+                block_variants=BV, max_batch=fleet.max_batch)
+            with ProjectionServer(engine, cache_entries=0) as single:
+                single_coords = single.project(q, timeout=60)
+            np.testing.assert_array_equal(got, single_coords)
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()
+
+
+def test_lru_eviction_restage_stays_bit_identical(fx):
+    """Budget of ONE panel: round-robin traffic churns the pool
+    (evictions + re-stages counted) and every answer — including the
+    first after a route's panel was just evicted and re-staged — stays
+    bit-identical to the offline path. The pool never exceeds budget."""
+    budget = int(PANEL_BYTES * 1.5)  # fits exactly one staged panel
+    fleet = _build(fx, budget_mb=budget / 1e6,
+                   cfg=ServeConfig(cache_entries=0)).start()
+    rng = np.random.default_rng(11)
+    try:
+        for sweep in range(3):
+            for route in fx.routes.values():
+                q = random_genotypes(rng, n=1, v=V, missing_rate=0.1)[0]
+                got = fleet.project(route.name, q, timeout=60)
+                np.testing.assert_array_equal(got, _offline(route, q))
+                assert fleet.pool.resident_bytes() <= budget
+                assert fleet.pool.resident_routes() == [route.name]
+        assert telemetry.counter_value("fleet.evictions") >= 6
+        assert telemetry.counter_value("fleet.restage_total") >= 6
+        # The store stayed clean through the churn: re-stages verified
+        # every chunk and nothing quarantined.
+        for route in fx.routes.values():
+            assert qledger.load(route.store) == []
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()
+
+
+def test_acceptance_multi_tenant_mix(fx):
+    """THE ISSUE-15 acceptance smoke: 3 routes under a 2-panel budget
+    driven by the multi-tenant mix — all traffic served, pool under
+    budget with evictions observed, interactive p99 under batch p99,
+    bit-identity spot-checked after the storm, no quarantine."""
+    budget = int(PANEL_BYTES * 2.5)  # fits two of the three panels
+    fleet = _build(fx, budget_mb=budget / 1e6,
+                   cfg=ServeConfig(cache_entries=0)).start()
+    rng = np.random.default_rng(13)
+    pools = {
+        name: random_genotypes(rng, n=24, v=V, missing_rate=0.1)
+        for name in fx.routes
+    }
+    mix = []
+    for name in fx.routes:
+        mix.append((name, INTERACTIVE, 1))
+        mix.append((name, BATCH, 2))
+    try:
+        report = run_fleet_loadgen(fleet, pools, mix,
+                                   requests_per_client=8)
+        assert report["errors"] == 0 and report["shed"] == 0
+        assert report["completed"] == 9 * 8
+        assert report["per_class"][INTERACTIVE]["p99_s"] > 0
+        assert (report["per_class"][INTERACTIVE]["p99_s"]
+                <= report["per_class"][BATCH]["p99_s"])
+        assert fleet.pool.resident_bytes() <= budget
+        assert telemetry.counter_value("fleet.evictions") > 0
+        assert telemetry.counter_value("fleet.restage_total") > 0
+        for route in fx.routes.values():
+            q = pools[route.name][0]
+            np.testing.assert_array_equal(
+                fleet.project(route.name, q, timeout=60),
+                _offline(route, q))
+            assert qledger.load(route.store) == []
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------------ priority
+
+
+def test_interactive_preempts_queued_batch(fx):
+    """With the worker stalled, batch requests queued FIRST are
+    overtaken by a later interactive request (completion order pinned
+    via done-callbacks; serve.priority.preemptions counts it)."""
+    fleet = _build(fx, cfg=ServeConfig(cache_entries=0,
+                                       max_linger_ms=0.0)).start()
+    rng = np.random.default_rng(17)
+    route = next(iter(fx.routes))
+    order: list[str] = []
+
+    def tag(name):
+        def cb(_fut):
+            order.append(name)
+        return cb
+
+    try:
+        qs = random_genotypes(rng, n=4, v=V, missing_rate=0.1)
+        with faults.armed(["serve.request:delay:delay=0.25:max=1"]):
+            stalled = fleet.submit(route, qs[0], priority=BATCH)
+            stalled.add_done_callback(tag("b0"))
+            time.sleep(0.05)  # the worker picks b0 up and stalls
+            b1 = fleet.submit(route, qs[1], priority=BATCH)
+            b1.add_done_callback(tag("b1"))
+            b2 = fleet.submit(route, qs[2], priority=BATCH)
+            b2.add_done_callback(tag("b2"))
+            i0 = fleet.submit(route, qs[3], priority=INTERACTIVE)
+            i0.add_done_callback(tag("i0"))
+            for f in (stalled, b1, b2, i0):
+                f.result(timeout=60)
+        assert order.index("i0") < order.index("b1")
+        assert order.index("i0") < order.index("b2")
+        assert telemetry.counter_value("serve.priority.preemptions") >= 1
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()
+
+
+def test_per_class_shed_thresholds(fx):
+    """The batch queue sheds at its own bound while interactive keeps
+    admitting — per-class counters prove which class was protected."""
+    fleet = _build(fx, cfg=ServeConfig(
+        cache_entries=0, max_linger_ms=0.0,
+        queue_interactive=8, queue_batch=2)).start()
+    rng = np.random.default_rng(19)
+    route = next(iter(fx.routes))
+    qs = random_genotypes(rng, n=12, v=V, missing_rate=0.1)
+    futs, shed_batch = [], 0
+    try:
+        with faults.armed(["serve.request:delay:delay=0.1:max=1"]):
+            futs.append(fleet.submit(route, qs[0], priority=BATCH))
+            time.sleep(0.05)  # worker stalled on the first request
+            for q in qs[1:8]:
+                try:
+                    futs.append(fleet.submit(route, q, priority=BATCH))
+                except ServerOverloaded:
+                    shed_batch += 1
+            assert shed_batch > 0
+            # The protected class still admits past batch's shedding.
+            futs.append(fleet.submit(route, qs[8],
+                                     priority=INTERACTIVE))
+            for f in futs:
+                f.result(timeout=60)
+        assert telemetry.counter_value(
+            "serve.priority.shed_batch") == shed_batch
+        assert telemetry.counter_value(
+            "serve.priority.shed_interactive") == 0
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()
+
+
+def test_per_class_default_deadlines(fx):
+    """ServeConfig's per-class deadlines apply by class: the batch
+    default expires a queued batch request while the interactive one
+    (no deadline) survives the same stall."""
+    fleet = _build(fx, cfg=ServeConfig(
+        cache_entries=0, max_linger_ms=0.0,
+        deadline_batch_ms=60.0)).start()
+    rng = np.random.default_rng(23)
+    route = next(iter(fx.routes))
+    qs = random_genotypes(rng, n=3, v=V, missing_rate=0.1)
+    try:
+        with faults.armed(["serve.request:delay:delay=0.25:max=1"]):
+            stalled = fleet.submit(route, qs[0], priority=INTERACTIVE)
+            time.sleep(0.05)
+            doomed = fleet.submit(route, qs[1], priority=BATCH)
+            safe = fleet.submit(route, qs[2], priority=INTERACTIVE)
+            assert stalled.result(timeout=60).shape == (1, 3)
+            assert safe.result(timeout=60).shape == (1, 3)
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=60)
+        assert telemetry.counter_value("serve.deadline_expired") == 1
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()
+
+
+def test_unknown_route_and_bad_priority(fx):
+    fleet = _build(fx).start()
+    try:
+        q = np.zeros(V, np.int8)
+        with pytest.raises(UnknownRoute, match="r-ibs"):
+            fleet.submit("nope", q)
+        with pytest.raises(ValueError, match="priority"):
+            fleet.submit("r-ibs", q, priority="urgent")
+        with pytest.raises(ValueError, match="dosage vector"):
+            fleet.submit("r-ibs", np.zeros(7, np.int8))
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------- fleet.stage chaos + breaker
+
+
+def test_fleet_stage_fault_feeds_breaker_then_recovers(fx):
+    """Injected fleet.stage io_errors fail exactly the waiting requests
+    (explicitly), feed the route's breaker to open (later requests fail
+    fast with PanelUnavailable, health degrades), and the half-open
+    probe re-stages bit-identically once the fault clears."""
+    fleet = _build(fx, cfg=ServeConfig(cache_entries=0)).start()
+    rng = np.random.default_rng(29)
+    route = fx.routes["r-ibs"]
+    now = [0.0]  # injected breaker clock: the reset window advances
+    # only when the test says so, not with wall time
+    fleet.routes[route.name].breaker = CircuitBreaker(
+        trip_after=2, reset_s=10.0, clock=lambda: now[0])
+    q = random_genotypes(rng, n=1, v=V, missing_rate=0.1)[0]
+    try:
+        with faults.armed(["fleet.stage:io_error:max=0"]) as inj:
+            for _ in range(2):
+                with pytest.raises(faults.InjectedFault):
+                    fleet.project(route.name, q, timeout=60)
+            assert inj.fire_count("fleet.stage") == 2
+            # Breaker tripped: the store is no longer touched.
+            with pytest.raises(PanelUnavailable):
+                fleet.project(route.name, q, timeout=60)
+            assert inj.fire_count("fleet.stage") == 2
+            assert fleet.health == "degraded"
+        # Disarmed, but r-ibs's breaker is still open (the injected
+        # clock has not reached the reset window): it keeps failing
+        # fast while OTHER routes serve right through the incident.
+        with pytest.raises(PanelUnavailable):
+            fleet.project(route.name, q, timeout=60)
+        other = fx.routes["r-pca"]
+        np.testing.assert_array_equal(
+            fleet.project(other.name, q, timeout=60),
+            _offline(other, q))
+        assert fleet.health == "degraded"
+        now[0] = 10.1  # reset window -> half-open probe
+        np.testing.assert_array_equal(
+            fleet.project(route.name, q, timeout=60),
+            _offline(route, q))
+        assert fleet.routes[route.name].breaker.state == "closed"
+        assert fleet.health == "healthy"
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()
+
+
+def test_fleet_stage_delay_is_absorbed(fx):
+    """A slow cold tier (fleet.stage delay) costs latency, never
+    correctness."""
+    fleet = _build(fx, cfg=ServeConfig(cache_entries=0)).start()
+    rng = np.random.default_rng(31)
+    route = fx.routes["r-jac"]
+    q = random_genotypes(rng, n=1, v=V, missing_rate=0.1)[0]
+    try:
+        with faults.armed(["fleet.stage:delay:delay=0.05:max=1"]):
+            np.testing.assert_array_equal(
+                fleet.project(route.name, q, timeout=60),
+                _offline(route, q))
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------- result-cache lifecycle (fix)
+
+
+def test_route_unload_evicts_cache_namespace_bytes_flat(fx):
+    """The lifecycle satellite: a load/serve/unload loop leaves the
+    shared result cache's byte accounting exactly where it started —
+    an unloaded route's namespace is evicted whole, not stranded in
+    the LRU."""
+    from spark_examples_tpu.serve.fleet import RouteSpec, build_route
+
+    fleet = _build(fx, cfg=ServeConfig(cache_entries=64)).start()
+    rng = np.random.default_rng(37)
+    extra = fx.routes["r-jac"]
+    spec = RouteSpec(name="tenant-x", model=extra.model,
+                     source=f"store:{extra.store}")
+    try:
+        fleet.unload_route("r-jac")  # keep only two permanent routes
+        q0 = random_genotypes(rng, n=1, v=V, missing_rate=0.1)[0]
+        fleet.project("r-ibs", q0, timeout=60)  # a resident entry
+        baseline = fleet._cache.stats()
+        assert baseline["bytes"] > 0
+        for cycle in range(3):
+            fleet.add_route(build_route(
+                spec, IngestConfig(block_variants=BV,
+                                   readahead_chunks=0), BV))
+            for k in range(4):
+                q = random_genotypes(rng, n=1, v=V,
+                                     missing_rate=0.1)[0]
+                fleet.project("tenant-x", q, timeout=60)
+            grown = fleet._cache.stats()
+            assert grown["bytes"] > baseline["bytes"]
+            assert fleet.unload_route("tenant-x")
+            after = fleet._cache.stats()
+            assert after == baseline, f"cycle {cycle}: cache leaked"
+        assert telemetry.counter_value(
+            "fleet.cache_namespace_evictions") == 3 * 4
+        # The permanent route's entry survived every eviction cycle.
+        before_hits = telemetry.counter_value("serve.cache_hits")
+        fleet.project("r-ibs", q0, timeout=60)
+        assert telemetry.counter_value("serve.cache_hits") \
+            == before_hits + 1
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------------- hedging
+
+
+def test_hedging_cuts_tail_on_delay_injected_replica(fx):
+    """Two replicas over the SAME stores (the shared cold tier); the
+    primary is delay-injected (a long linger holds every batch). The
+    hedged run's p99 lands well under the unhedged run's, hedges win,
+    and nothing errors — first answer wins, the loser is cancelled."""
+    slow_cfg = ServeConfig(cache_entries=0, max_linger_ms=120.0)
+    fast_cfg = ServeConfig(cache_entries=0, max_linger_ms=0.0)
+    slow = _build(fx, cfg=slow_cfg).start()
+    fast = _build(fx, cfg=fast_cfg).start()
+    rng = np.random.default_rng(41)
+    pool = random_genotypes(rng, n=32, v=V, missing_rate=0.1)
+    route = "r-ibs"
+    try:
+        unhedged = run_hedged_loadgen(
+            [slow, slow], pool, clients=2, requests_per_client=6,
+            route=route, hedge_floor_s=10.0)  # floor past every
+        # request: the hedge never fires — the no-hedge baseline
+        # through the same code path.
+        assert unhedged["hedge_launched"] == 0
+        assert unhedged["errors"] == 0
+        hedged = run_hedged_loadgen(
+            [slow, fast], pool, clients=2, requests_per_client=6,
+            route=route, hedge_floor_s=0.02)
+        assert hedged["errors"] == 0
+        assert hedged["completed"] == 12
+        assert hedged["hedge_launched"] > 0
+        assert hedged["hedge_wins"] > 0
+        assert hedged["hedge_win_frac"] > 0.5
+        assert hedged["p99_s"] < unhedged["p99_s"]
+        assert telemetry.counter_value("fleet.hedge_wins") \
+            == hedged["hedge_wins"]
+    finally:
+        slow.close()
+        fast.close()
+
+
+# ------------------------------------------------------- pool semantics
+
+
+def test_panel_pool_unit_semantics():
+    """PanelPool in isolation: LRU order, budget eviction, restage
+    accounting, oversize tolerance (warn, serve anyway), and remove()
+    forgetting the staged-before history."""
+    pool = PanelPool(1000)
+
+    def stage(nbytes):
+        return lambda: ([("blocks", None)], 64, nbytes)
+
+    pool.acquire("a", stage(400))
+    pool.acquire("b", stage(400))
+    assert pool.resident_routes() == ["a", "b"]
+    pool.acquire("a", lambda: (_ for _ in ()).throw(
+        AssertionError("hit must not re-stage")))
+    assert pool.resident_routes() == ["b", "a"]  # LRU refreshed
+    pool.acquire("c", stage(400))  # 1200 > 1000: evicts LRU ("b")
+    assert pool.resident_routes() == ["a", "c"]
+    assert telemetry.counter_value("fleet.evictions") == 1
+    pool.acquire("b", stage(400))  # b again: restage counted
+    assert telemetry.counter_value("fleet.restage_total") == 1
+    with pytest.warns(RuntimeWarning, match="exceeds the pool budget"):
+        pool.acquire("huge", stage(5000))
+    assert pool.is_staged("huge")  # served unevictable, loudly
+    pool.remove("huge")
+    with pytest.warns(RuntimeWarning, match="exceeds the pool budget"):
+        pool.acquire("huge", stage(5000))
+    # remove() forgot the history: that was a first stage, not a
+    # restage.
+    assert telemetry.counter_value("fleet.restage_total") == 1
+
+
+def test_pool_stage_failure_leaves_pool_consistent():
+    pool = PanelPool(1000)
+    with pytest.raises(RuntimeError, match="boom"):
+        pool.acquire("a", lambda: (_ for _ in ()).throw(
+            RuntimeError("boom")))
+    assert not pool.is_staged("a")
+    assert pool.resident_bytes() == 0
+
+
+# ------------------------------------------------------------ HTTP front
+
+
+def test_fleet_http_front(fx):
+    from spark_examples_tpu.serve.http import start_fleet_http_server
+
+    fleet = _build(fx).start()
+    http = start_fleet_http_server(fleet, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    rng = np.random.default_rng(43)
+    q = random_genotypes(rng, n=1, v=V, missing_rate=0.1)[0]
+    try:
+        req = urllib.request.Request(
+            f"{base}/project",
+            data=json.dumps({
+                "route": "r-ibs", "priority": BATCH,
+                "genotypes": [int(x) for x in q],
+            }).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        want = _offline(fx.routes["r-ibs"], q).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(out["coords"], np.float32), want)
+        # Path-addressed form: POST /project/<route>.
+        req2 = urllib.request.Request(
+            f"{base}/project/r-pca",
+            data=json.dumps(
+                {"genotypes": [int(x) for x in q]}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req2, timeout=60) as resp:
+            out2 = json.loads(resp.read())
+        np.testing.assert_array_equal(
+            np.asarray(out2["coords"], np.float32),
+            _offline(fx.routes["r-pca"], q).astype(np.float32))
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "healthy"
+        assert set(health["routes"]) == set(fx.routes)
+        with urllib.request.urlopen(f"{base}/routes", timeout=30) as r:
+            routes = json.loads(r.read())
+        assert routes["r-ibs"]["completed"] >= 1
+        assert routes["r-ibs"]["staged"] is True
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["pool"]["resident_bytes"] > 0
+        assert stats["result_cache"]["entries"] >= 1
+        # The per-route autoscale series land on the Prometheus plane.
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            prom = r.read().decode()
+        assert "fleet_routes" in prom
+        assert "fleet_pool_bytes" in prom
+        assert "fleet_route_r_ibs_queue_depth" in prom
+        assert "fleet_route_r_ibs_p99_s" in prom
+        assert "serve_priority_depth_interactive" in prom
+        # Error surface: unknown route 404, missing route 400.
+        bad = urllib.request.Request(
+            f"{base}/project",
+            data=json.dumps({"route": "nope",
+                             "genotypes": [0] * V}).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=30)
+        assert err.value.code == 404
+        bad2 = urllib.request.Request(
+            f"{base}/project",
+            data=json.dumps({"genotypes": [0] * V}).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad2, timeout=30)
+        assert err.value.code == 400
+    finally:
+        http.shutdown()
+        fleet.close()
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_serve_fleet_cli_loadgen(fx, tmp_path, capsys):
+    """`serve --fleet manifest.json --loadgen N` end to end: the
+    multi-tenant report (interactive + batch clients per route) prints
+    as JSON with per-class percentiles and fleet stats."""
+    from spark_examples_tpu.cli.main import main
+
+    manifest_path = tmp_path / "fleet.json"
+    manifest_path.write_text(json.dumps(
+        _manifest_doc(fx, budget_mb=1.0)))
+    rc = main([
+        "serve", "--fleet", str(manifest_path),
+        "--source", "synthetic", "--n-samples", "4",
+        "--block-variants", str(BV), "--readahead-chunks", "0",
+        "--max-batch", "4", "--max-linger-ms", "1",
+        "--loadgen", "1", "--loadgen-requests", "4",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # 3 routes x 2 classes x 1 client x 4 requests
+    assert report["completed"] == 24 and report["errors"] == 0
+    assert set(report["per_class"]) == set(PRIORITY_CLASSES)
+    assert set(report["per_route"]) == set(fx.routes)
+    assert report["stats"]["pool"]["resident_bytes"] > 0
+
+
+def test_serve_cli_fleet_and_model_are_exclusive(fx, tmp_path):
+    from spark_examples_tpu.cli.main import main
+
+    manifest_path = tmp_path / "fleet.json"
+    manifest_path.write_text(json.dumps(_manifest_doc(fx)))
+    with pytest.raises(SystemExit):
+        main(["serve", "--fleet", str(manifest_path),
+              "--model", "m.npz"])
+    with pytest.raises(SystemExit):
+        main(["serve"])  # neither --model nor --fleet

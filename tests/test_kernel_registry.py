@@ -48,12 +48,13 @@ def test_builtin_registrations_complete():
     hand-listed."""
     assert set(ALL_METRICS) == {
         "ibs", "ibs2", "shared-alt", "euclidean", "dot", "king",
-        "jaccard", "grm", "braycurtis",
+        "jaccard", "pc-invariant", "grm", "braycurtis",
     }
     assert set(GRAM_METRICS) == set(ALL_METRICS) - {"braycurtis"}
     assert set(SKETCH_METRICS) == {"shared-alt", "grm", "dot", "euclidean"}
     assert set(DUAL_SKETCH_METRICS) == {"ibs", "jaccard"}
-    assert set(kernels.unsketchable_names()) == {"ibs2", "king"}
+    assert set(kernels.unsketchable_names()) == {"ibs2", "king",
+                                                 "pc-invariant"}
     # Consumers' tables are registry-derived.
     assert set(gram.GRAM_METRICS) == set(GRAM_METRICS)
     assert set(gram.DOSAGE_METRICS) == {
@@ -254,7 +255,9 @@ def test_packed_vs_dense_bit_identity(rng, metric):
                                   out["packed"].distance)
 
 
-@pytest.mark.parametrize("metric", ["ibs", "ibs2", "king", "jaccard"])
+@pytest.mark.parametrize("metric",
+                         ["ibs", "ibs2", "king", "jaccard",
+                          "pc-invariant"])
 def test_tile2d_multi_device_matches_replicated(rng, metric):
     """Counting kernels are integer-exact, so the tile2d plan over the
     8 virtual devices must match the replicated single-accumulator plan
@@ -340,6 +343,78 @@ def test_jaccard_duplicate_detection():
     assert sim[0, 5] == 1.0
     others = sim[0, [j for j in range(1, 10) if j != 5]]
     assert others.max() < 0.95
+
+
+# -------------------------------------------------------- pc-invariant
+
+
+def _naive_pc_invariant(g: np.ndarray) -> np.ndarray:
+    """Deliberately-independent oracle: apply the kernel's 3x3
+    piecewise-constant table W(a, b) directly, per pair, per variant —
+    no matmuls, no pieces algebra, nothing shared with the production
+    route (the arXiv:2404.07183 definition applied literally)."""
+    w = np.array([[1.0, 0.0, -1.0],
+                  [0.0, 1.0, 0.0],
+                  [-1.0, 0.0, 1.0]])
+    n = g.shape[0]
+    sim = np.ones((n, n))
+    for i in range(n):
+        for j in range(n):
+            both = (g[i] >= 0) & (g[j] >= 0)
+            m = int(both.sum())
+            if m:
+                sim[i, j] = w[g[i][both], g[j][both]].sum() / m
+    return sim
+
+
+def test_pc_invariant_matches_table_oracle(genotypes):
+    """Golden values: the registry's pieces/stats recombination of the
+    piecewise-constant invariant table equals the direct per-pair
+    table application; symmetry, exact unit diagonal, [-1, 1] range,
+    and the [0, 1] distance transform all hold."""
+    out = distances.finalize(_dense_acc(genotypes, "pc-invariant"),
+                             "pc-invariant")
+    sim = np.asarray(out["similarity"])
+    np.testing.assert_allclose(sim, _naive_pc_invariant(genotypes),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(sim, sim.T, atol=1e-7)
+    np.testing.assert_allclose(np.diag(sim), 1.0, atol=1e-7)
+    assert (sim >= -1 - 1e-6).all() and (sim <= 1 + 1e-6).all()
+    d = np.asarray(out["distance"])
+    np.testing.assert_allclose(d, (1.0 - sim) / 2.0, atol=1e-6)
+    assert (d >= 0).all() and (d <= 1 + 1e-6).all()
+
+
+def test_pc_invariant_table_semantics():
+    """The table's three plateaus, pinned directly: identical
+    genotypes +1, opposite homozygotes -1, everything else 0 — and
+    pairs sharing no complete variants read 1 (indistinguishable from
+    identical, the ibs/jaccard convention), keeping self-distance
+    exactly 0."""
+    g = np.array([
+        [0, 0, 0, 0],    # hom-ref
+        [2, 2, 2, 2],    # opposite homozygote of row 0
+        [1, 1, 1, 1],    # het: 0 against both
+        [0, 0, 2, 2],    # half match / half opposite vs row 0
+        [-1, -1, -1, -1],  # all-missing: no complete pairs
+    ], np.int8)
+    out = distances.finalize(_dense_acc(g, "pc-invariant"),
+                             "pc-invariant")
+    sim = np.asarray(out["similarity"])
+    assert sim[0, 0] == 1.0
+    assert sim[0, 1] == -1.0 and sim[1, 0] == -1.0
+    assert sim[0, 2] == 0.0 and sim[1, 2] == 0.0
+    assert sim[0, 3] == 0.0  # (+1 +1 -1 -1) / 4
+    assert sim[0, 4] == 1.0 and sim[4, 4] == 1.0  # empty-overlap
+    d = np.asarray(out["distance"])
+    assert d[0, 1] == 1.0 and d[4, 4] == 0.0
+
+
+def test_pc_invariant_exact_rung_only():
+    """The indefinite table has no sketch form; the registry-derived
+    rejection names it with the exact-rung fix."""
+    with pytest.raises(ValueError, match="--solver exact"):
+        ComputeConfig(metric="pc-invariant", solver="sketch")
 
 
 def test_jaccard_end_to_end_eigensolve_serve(rng, tmp_path):

@@ -257,6 +257,23 @@ def test_core_names_present():
         # solve path
         "solver.dual",
         "solver.dual_den_defect",
+        # fleet serving: warm pool, priority admission, hedging (the
+        # fleet PR's instrumentation contract)
+        "fleet.stage",
+        "fleet.restage_total",
+        "fleet.evictions",
+        "fleet.routes",
+        "fleet.pool_bytes",
+        "fleet.pool_pressure",
+        "fleet.route.*",
+        "fleet.cache_namespace_evictions",
+        "fleet.hedge_launched",
+        "fleet.hedge_wins",
+        "serve.priority.preemptions",
+        "serve.priority.depth_interactive",
+        "serve.priority.depth_batch",
+        "serve.priority.shed_interactive",
+        "serve.priority.shed_batch",
         # live telemetry plane + trend tracking (this PR's
         # instrumentation contract)
         "live.flush",

@@ -60,6 +60,17 @@ def test_metric_directions_resolve_sensibly():
     assert d("multichip_overlap_frac") == trend.HIGHER_IS_BETTER
     assert d("multichip_solve_n100k_s") == trend.LOWER_IS_BETTER
     assert d("multichip_ok") == trend.BOOL_MUST_HOLD
+    # Fleet serving (bench --fleet): the per-class p99s fall, QPS
+    # rises, the composite gate holds; route count / forced-eviction
+    # churn / the injected-delay hedge demo's win fraction are
+    # workload shape, never gated.
+    assert d("fleet_p99_interactive_s") == trend.LOWER_IS_BETTER
+    assert d("fleet_p99_batch_s") == trend.LOWER_IS_BETTER
+    assert d("fleet_sustained_qps") == trend.HIGHER_IS_BETTER
+    assert d("fleet_ok") == trend.BOOL_MUST_HOLD
+    assert d("fleet_routes") is None
+    assert d("fleet_evictions") is None
+    assert d("fleet_hedge_win_frac") is None
     # Static-analysis gate (bench headline, the graftlint PR): the
     # suite must stay clean — lint_ok HOLDS, and the finding count can
     # only fall. A tree that got faster but picked up an invariant

@@ -204,6 +204,7 @@ class Context:
             "braycurtis method": (tuple(C.BRAYCURTIS_METHODS), mod),
             "backend": (tuple(C.BACKENDS), mod),
             "pack stream": (tuple(C.PACK_STREAMS), mod),
+            "priority class": (tuple(C.PRIORITY_CLASSES), mod),
         }
 
     # -- package module index (for the import-graph rule) --

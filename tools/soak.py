@@ -70,6 +70,7 @@ from spark_examples_tpu.store.writer import compact
 # the production tree carries one of these prefixes, so a new thread
 # family that can leak must add itself here to pass tier-1.
 _SUSPECT_THREADS = ("store-readahead", "projection-serve-worker",
+                    "fleet-serve-worker",
                     "supervisor-heartbeat", "telemetry-flusher",
                     "prefetch-producer", "partitioned-reader",
                     "projection-http", "live-telemetry-http",
@@ -107,6 +108,14 @@ SCENARIOS: tuple = (
     ("serve", "serve.request", "io_error", dict(after=(0, 5), max=(1, 1))),
     ("serve", "serve.request", "delay", dict(after=(0, 5), max=(1, 2),
                                              delay=0.02)),
+    # Fleet rounds: a 2-route fleet under a one-panel budget, so the
+    # round-robin traffic churns LRU eviction + re-stage through the
+    # fleet.stage site — an io_error fails exactly the requests
+    # waiting on that stage (the rest stay bit-identical), a delay is
+    # a slow cold tier (latency, never correctness).
+    ("fleet", "fleet.stage", "io_error", dict(after=(0, 4), max=(1, 2))),
+    ("fleet", "fleet.stage", "delay", dict(after=(0, 4), max=(1, 2),
+                                           delay=0.01)),
     # Every gram round runs a periodic live-telemetry flusher; a flush
     # that fails must be absorbed (warned + counted) with the job —
     # and every published snapshot — intact.
@@ -259,7 +268,6 @@ class _Fixture:
                              block_variants=cfg.block_variants,
                              readahead_chunks=0)),
             block_variants=cfg.block_variants, max_batch=4)
-        self.thread_baseline = _suspect_counts()
         pool_rng = np.random.default_rng(11)
         self.query_pool = pool_rng.integers(
             0, 3, size=(6, cfg.n_variants)).astype(np.int8)
@@ -267,6 +275,60 @@ class _Fixture:
             self.engine.project_batch(q[None, :])
             for q in self.query_pool
         ]
+        # Fleet fixture: a SECOND model (PCA) on the same store panel,
+        # plus clean per-route baselines from an unfaulted fleet — the
+        # fleet rounds churn eviction/re-stage between the two routes
+        # under a one-panel budget.
+        from spark_examples_tpu.pipelines.jobs import variants_pca_job
+
+        self.pca_model_path = os.path.join(cfg.workdir, "model_pca.npz")
+        pca_panel = runner.build_source(
+            IngestConfig(source="store", path=self.store_dir,
+                         block_variants=cfg.block_variants))
+        variants_pca_job(
+            JobConfig(
+                ingest=IngestConfig(block_variants=cfg.block_variants),
+                compute=ComputeConfig(num_pc=3),
+                model_path=self.pca_model_path,
+            ),
+            source=pca_panel)
+        self._close_source(pca_panel)
+        self.fleet_baseline: dict[str, list] = {}
+        fleet = self.make_fleet()
+        try:
+            fleet.start()
+            for route in ("ibs", "pca"):
+                self.fleet_baseline[route] = [
+                    fleet.project(route, q, timeout=60.0)
+                    for q in self.query_pool
+                ]
+        finally:
+            fleet.close()
+        self.thread_baseline = _suspect_counts()
+
+    def make_fleet(self):
+        """A fresh 2-route fleet over the soak store: budget sized for
+        ONE staged panel, so alternating-route traffic must evict and
+        re-stage through fleet.stage every switch."""
+        from spark_examples_tpu.core.config import ServeConfig
+        from spark_examples_tpu.serve import FleetManifest, build_fleet
+
+        panel_bytes = self.cfg.n_samples * self.cfg.n_variants
+        manifest = FleetManifest.parse({
+            "budget_mb": panel_bytes * 1.5 / 1e6,
+            "routes": [
+                {"name": "ibs", "model": self.model_path,
+                 "source": f"store:{self.store_dir}"},
+                {"name": "pca", "model": self.pca_model_path,
+                 "source": f"store:{self.store_dir}"},
+            ],
+        })
+        return build_fleet(
+            manifest, ServeConfig(cache_entries=0),
+            ingest_defaults=IngestConfig(
+                block_variants=self.cfg.block_variants,
+                readahead_chunks=2, store_cache_mb=4),
+        )
 
     @staticmethod
     def _close_source(src) -> None:
@@ -403,6 +465,60 @@ def _run_serve_round(fx: _Fixture, spec: str,
     return problems
 
 
+def _run_fleet_round(fx: _Fixture, spec: str,
+                     round_seed: int) -> list[str]:
+    """One in-process fleet round: a fresh 2-route fleet under a
+    one-panel budget, alternating-route traffic so every route switch
+    is an eviction + fleet.stage re-stage. Injected stage io_errors
+    must fail exactly their own waiting request (explicitly — either
+    the injected error, or PanelUnavailable if they tripped the route
+    breaker); every other answer must be bit-identical to the clean
+    fleet baseline; the drain must be clean."""
+    from spark_examples_tpu.serve import PanelUnavailable
+
+    problems: list[str] = []
+    fleet = fx.make_fleet()
+    injected = 0
+    try:
+        fleet.start()
+        with faults.armed([spec], seed=round_seed) as inj:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for _sweep in range(2):
+                    for route in ("ibs", "pca"):
+                        for qi, q in enumerate(fx.query_pool):
+                            try:
+                                got = fleet.project(route, q,
+                                                    timeout=30.0)
+                            except (faults.InjectedFault,
+                                    PanelUnavailable):
+                                injected += 1
+                                continue
+                            if not np.array_equal(
+                                    got, fx.fleet_baseline[route][qi]):
+                                problems.append(
+                                    f"fleet coords for {route}[{qi}] "
+                                    "differ from baseline")
+            fired = inj.fire_count("fleet.stage")
+        if "io_error" in spec and injected < fired:
+            problems.append(
+                f"{fired} fleet.stage io_error(s) fired but only "
+                f"{injected} request(s) failed with the injected "
+                "error — a stage failure was swallowed")
+        if "delay" in spec and injected:
+            problems.append(
+                f"{injected} request(s) failed under a delay-only "
+                "spec — a slow cold tier must cost latency, never "
+                "correctness")
+        if fleet.pool.resident_bytes() > fleet.pool.budget_bytes:
+            problems.append("fleet pool over its configured budget")
+        if not fleet.drain(timeout=30.0):
+            problems.append("fleet drain was not clean")
+    finally:
+        fleet.close()
+    return problems
+
+
 def _run_kill_round(fx: _Fixture, i: int, spec: str, round_seed: int,
                     baseline_tsv: bytes) -> tuple[list[str], int]:
     """One supervised subprocess round: the CLI job with an injected
@@ -511,6 +627,8 @@ def run_soak(cfg: SoakConfig) -> SoakReport:
                                            metric="dot")
             elif jobkind == "serve":
                 problems = _run_serve_round(fx, spec, round_seed)
+            elif jobkind == "fleet":
+                problems = _run_fleet_round(fx, spec, round_seed)
             else:
                 problems, restarts = _run_kill_round(
                     fx, i, spec, round_seed, baseline_tsv)

@@ -82,6 +82,15 @@ _EXPLICIT: dict[str, int | None] = {
     "chaos_soak_iterations": None,
     "chaos_soak_healed": None,
     "chaos_soak_faults_fired": None,
+    # Fleet bench (bench --fleet): the route count is workload shape,
+    # the eviction count is the budget-forced churn the bench INTENDS
+    # (a "regression" to fewer evictions would just mean the mix
+    # changed), and the hedge win fraction measures the injected-delay
+    # demo's asymmetry, not code quality — the p99s/QPS/ok gate
+    # through the ordinary suffix rules.
+    "fleet_routes": None,
+    "fleet_evictions": None,
+    "fleet_hedge_win_frac": None,
 }
 
 # (match kind, token, direction) — first hit wins, checked in order:
