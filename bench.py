@@ -114,6 +114,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -1408,6 +1409,204 @@ def bench_fleet() -> dict:
     }
 
 
+def bench_controller() -> dict:
+    """``--controller``: the fleet control plane closing the autoscale
+    loop (README 'Fleet control plane'). One compacted store, two
+    fitted models (ibs PCoA + variants PCA) served by in-process
+    LocalReplica fleets under a FleetController running its production
+    watch loop. Three headline numbers:
+
+    - time-to-scale-up: a seeded BurstSchedule drives open-loop
+      interactive arrivals into a 1-replica pool; sustained queue
+      pressure must spawn replica #2 — reported as seconds from the
+      schedule's start to the new replica serving (detection + spawn
+      + warm under whatever pressure lands first).
+    - burst shed rate: the fraction of offered arrivals shed
+      (ServerOverloaded) across the whole schedule — capacity the
+      controller adds is exactly what keeps this low.
+    - p99 across a replica loss: a hedged closed loop over the pool
+      while the primary is killed mid-run; the zero-loss contract
+      means failovers, never errors, and the controller respawns the
+      corpse within its backoff budget."""
+    import tempfile
+
+    from spark_examples_tpu.core.config import (
+        PRIORITY_CLASSES, ComputeConfig, IngestConfig, JobConfig,
+        ServeConfig,
+    )
+    from spark_examples_tpu.fleet import (
+        ControllerConfig, FleetController, LocalReplica,
+    )
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.pipelines.jobs import pcoa_job, variants_pca_job
+    from spark_examples_tpu.serve import (
+        BurstSchedule, FleetManifest, ServerClosed, ServerOverloaded,
+        build_fleet, run_hedged_loadgen,
+    )
+    from spark_examples_tpu.store.writer import compact
+
+    n, nv = 192, 4096
+    panel_bytes = n * nv
+    os.makedirs(CACHE, exist_ok=True)
+    workdir = tempfile.mkdtemp(prefix="bench_ctrl_", dir=CACHE)
+    rng = np.random.default_rng(31)
+    g = np.where(rng.random((n, nv)) < 0.02, -1,
+                 rng.integers(0, 3, (n, nv))).astype(np.int8)
+    store_dir = os.path.join(workdir, "store")
+    compact(store_dir, ArraySource(g), chunk_variants=2048)
+    models = {}
+    for name, fit, metric in (("ibs", pcoa_job, "ibs"),
+                              ("pca", variants_pca_job, None)):
+        model = os.path.join(workdir, f"model_{name}.npz")
+        fit(JobConfig(
+            ingest=IngestConfig(block_variants=BLOCK),
+            compute=ComputeConfig(metric=metric, num_pc=4),
+            model_path=model,
+        ), source=ArraySource(g))
+        models[name] = model
+    manifest = FleetManifest.parse({
+        "budget_mb": panel_bytes * 2.5 / 1e6,
+        "routes": [
+            {"name": "ibs", "model": models["ibs"],
+             "source": f"store:{store_dir}"},
+            {"name": "pca", "model": models["pca"],
+             "source": f"store:{store_dir}"},
+        ],
+    })
+    # A deliberately modest replica: slow-ish coalescing + a short
+    # interactive queue, so the burst visibly queues and sheds until
+    # the controller adds capacity.
+    serve_cfg = ServeConfig(cache_entries=0, max_linger_ms=20.0,
+                            queue_interactive=16)
+
+    def factory(slot_name, generation):
+        def make():
+            return build_fleet(
+                manifest, serve_cfg,
+                ingest_defaults=IngestConfig(
+                    block_variants=BLOCK, readahead_chunks=0),
+            ).start()
+        return LocalReplica(slot_name, make,
+                            budget_bytes=int(panel_bytes * 2.5),
+                            generation=generation)
+
+    ledger_path = os.path.join(workdir, "controller.json")
+    ctrl = FleetController(
+        factory, {"ibs": panel_bytes, "pca": panel_bytes},
+        ControllerConfig(
+            min_replicas=1, max_replicas=3, interval_s=0.02,
+            scale_up_depth=4.0, pressure_rounds=2, idle_rounds=10_000,
+            backoff_initial_s=0.05, backoff_max_s=1.0,
+            flap_window_s=60.0, flap_max_respawns=10,
+            drain_timeout_s=30.0, ledger_path=ledger_path,
+        ))
+    pool_rng = np.random.default_rng(17)
+    pool = np.where(pool_rng.random((64, nv)) < 0.02, -1,
+                    pool_rng.integers(0, 3, (64, nv))).astype(np.int8)
+    sched = BurstSchedule(duration_s=6.0, base_qps=20.0, seed=23,
+                          n_bursts=2, burst_factor=8.0)
+    arrivals = sched.arrivals()
+    first_burst_t = sched.bursts[0][0] if sched.bursts else 0.0
+    offered, shed, open_errors = len(arrivals), 0, 0
+    futures = []
+    scale_up_s = None
+    try:
+        ctrl.start().run()
+        t0 = time.perf_counter()
+        rr = 0
+        for k, at in enumerate(arrivals):
+            lag = at - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            reps = ctrl.replicas()
+            if scale_up_s is None and len(reps) >= 2:
+                # Anchored at schedule start: detection + spawn +
+                # warm, under whatever pressure came first (cold-start
+                # compile or the seeded burst).
+                scale_up_s = time.perf_counter() - t0
+            r = reps[rr % len(reps)].router
+            rr += 1
+            try:
+                futures.append(r.submit(
+                    "ibs", pool[k % len(pool)],
+                    priority=PRIORITY_CLASSES[0]))
+            except ServerOverloaded:
+                shed += 1
+            except ServerClosed:
+                open_errors += 1
+        for f in futures:
+            try:
+                f.result(timeout=300.0)
+            except Exception:
+                open_errors += 1
+        if scale_up_s is None and len(ctrl.replicas()) >= 2:
+            scale_up_s = time.perf_counter() - t0
+        # Replica loss mid-hedged-run: the pool keeps answering.
+        deadline = time.monotonic() + 30.0
+        while len(ctrl.replicas()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        routers = [r.router for r in ctrl.replicas()]
+        scaled = len(routers) >= 2
+
+        def _kill_primary():
+            time.sleep(0.3)
+            reps_now = ctrl.replicas()
+            if reps_now:
+                reps_now[0].kill()
+
+        kt = threading.Thread(target=_kill_primary,
+                              name="loadgen-client-kill", daemon=True)
+        kt.start()
+        loss = run_hedged_loadgen(
+            routers, pool, clients=2, requests_per_client=20,
+            route="ibs", hedge_floor_s=0.05, result_timeout_s=300.0,
+            seed=23)
+        kt.join(timeout=30.0)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            reps = ctrl.replicas()
+            if len(reps) >= 2 and all(r.alive() for r in reps):
+                break
+            time.sleep(0.05)
+        reps = ctrl.replicas()
+        healed = len(reps) >= 2 and all(r.alive() for r in reps)
+        desc = ctrl.describe()
+    finally:
+        ctrl.close()
+    with open(ledger_path) as f:
+        ledger = json.load(f)
+    shed_rate = shed / max(1, offered)
+    actions = {d["action"] for d in ledger["decisions"]}
+    ok = bool(
+        scaled and healed and scale_up_s is not None
+        and open_errors == 0 and loss["errors"] == 0
+        and loss["failovers"] > 0
+        and {"scale_up", "respawn"} <= actions
+    )
+    log(f"controller: offered {offered} arrivals "
+        f"(first burst at {first_burst_t:.2f}s), scale-up in "
+        f"{-1.0 if scale_up_s is None else scale_up_s:.2f}s, shed rate "
+        f"{shed_rate:.3f}, p99 across replica loss "
+        f"{loss['p99_s'] * 1e3:.1f} ms ({loss['failovers']} failovers, "
+        f"{loss['errors']} errors), healed={healed}, "
+        f"replicas={len(reps)}, ok={ok}")
+    return {
+        "panel": [n, nv],
+        "offered": offered,
+        "shed": shed,
+        "shed_rate": round(shed_rate, 4),
+        "scale_up_s": scale_up_s,
+        "p99_loss_s": loss["p99_s"],
+        "loss_failovers": loss["failovers"],
+        "loss_errors": loss["errors"] + open_errors,
+        "replicas": len(reps),
+        "healed": healed,
+        "rounds": desc["rounds"],
+        "decisions": sorted(actions),
+        "ok": ok,
+    }
+
+
 STORE_BENCH_VARIANTS = 16_384  # store-bench cohort width (full N_SAMPLES)
 STORE_BENCH_CHUNK = 2_048      # store-bench chunk grid: 8 chunks, so the
                                # readahead pool / adaptive depth have a
@@ -1992,6 +2191,13 @@ def main() -> None:
             log(f"fleet FAILED: {e!r}")
             configs["fleet"] = {"error": repr(e)}
 
+    if "--controller" in sys.argv:
+        try:
+            configs["controller"] = bench_controller()
+        except Exception as e:
+            log(f"controller FAILED: {e!r}")
+            configs["controller"] = {"error": repr(e)}
+
     if "--store" in sys.argv:
         try:
             configs["store"] = bench_store(store)
@@ -2110,6 +2316,13 @@ def main() -> None:
             and fl["hedge_hedged_p99_s"] < fl["hedge_unhedged_p99_s"]
             and fl["hedge_errors"] == 0
         )
+    if "controller" in configs and "error" not in configs["controller"]:
+        ct = configs["controller"]
+        headline["controller_scale_up_s"] = ct["scale_up_s"]
+        headline["controller_burst_shed_rate"] = ct["shed_rate"]
+        headline["controller_p99_loss_s"] = ct["p99_loss_s"]
+        headline["controller_replicas"] = ct["replicas"]
+        headline["controller_ok"] = bool(ct["ok"])
     if "store" in configs and "error" not in configs["store"]:
         headline["store_hit_vs_cold_parse"] = configs["store"][
             "store_hit_vs_cold_parse"]

@@ -506,6 +506,23 @@ def main(argv: list[str] | None = None) -> int:
                        "offered/sustained QPS + latency report as JSON")
     p_srv.add_argument("--loadgen-requests", type=int, default=50,
                        help="requests per loadgen client")
+    p_srv.add_argument("--loadgen-seed", type=int,
+                       default=config.ServeConfig.loadgen_seed,
+                       help="seeds the loadgen hedge-delay ring and "
+                       "burst schedule so SOAK-REPRO lines and bench "
+                       "runs replay deterministically")
+    p_srv.add_argument("--drain-timeout-s", type=float,
+                       default=config.ServeConfig.drain_timeout_s,
+                       help="SIGTERM drain budget: admitted requests "
+                       "get this long to resolve; stragglers past it "
+                       "fail loudly (ServerClosed) and are counted in "
+                       "serve.drain_abandoned in the final telemetry "
+                       "flush")
+    p_srv.add_argument("--port-file", default=None, metavar="PATH",
+                       help="after the HTTP endpoint binds, atomically "
+                       "write {\"port\": N} here — how a controller "
+                       "parent discovers an ephemeral (--port 0) "
+                       "child's address")
 
     p_ck = sub.add_parser(
         "cross-kinship",
@@ -1009,6 +1026,18 @@ def _dispatch(args, parser, job, J, build_source) -> int:
     return 0
 
 
+def _write_port_file(path, port) -> None:
+    """--port-file: atomically publish the bound port so a controller
+    parent can discover an ephemeral (--port 0) child's address — the
+    rename is the commit point, so the parent never reads a torn
+    file."""
+    if not path:
+        return
+    from spark_examples_tpu.core import telemetry as _tel
+
+    _tel._atomic_write(path, json.dumps({"port": int(port)}))
+
+
 def _run_serve(args, parser, job, build_source) -> int:
     """The `serve` subcommand: engine + server up, then either a local
     HTTP endpoint (default; Ctrl-C drains) or an in-process closed-loop
@@ -1046,6 +1075,8 @@ def _run_serve(args, parser, job, build_source) -> int:
             queue_batch=args.queue_batch,
             deadline_interactive_ms=args.deadline_interactive_ms,
             deadline_batch_ms=args.deadline_batch_ms,
+            drain_timeout_s=args.drain_timeout_s,
+            loadgen_seed=args.loadgen_seed,
         )
     except ValueError as e:
         parser.error(str(e))
@@ -1064,6 +1095,7 @@ def _run_serve(args, parser, job, build_source) -> int:
         max_queue=cfg.max_queue,
         cache_entries=cfg.cache_entries,
         default_deadline_s=(cfg.deadline_ms / 1e3) or None,
+        drain_timeout_s=cfg.drain_timeout_s,
     )
     server.start()
     try:
@@ -1098,6 +1130,7 @@ def _run_serve(args, parser, job, build_source) -> int:
 
             http = ProjectionHTTPServer(server, host=cfg.host,
                                         port=cfg.port)
+            _write_port_file(args.port_file, http.port)
 
             # SIGTERM (the orchestrator's stop signal — and the only
             # deliverable one when SIGINT was inherited ignored) must
@@ -1176,6 +1209,7 @@ def _run_serve_fleet(args, parser, job, cfg, build_source) -> int:
             from spark_examples_tpu.serve.http import fleet_http_server
 
             http = fleet_http_server(fleet, host=cfg.host, port=cfg.port)
+            _write_port_file(args.port_file, http.port)
 
             def _sigterm(signum, frame):
                 raise KeyboardInterrupt

@@ -523,6 +523,14 @@ class ServeConfig:
     queue_batch: int = 256
     deadline_interactive_ms: float = 0.0
     deadline_batch_ms: float = 0.0
+    # SIGTERM drain budget: admitted requests get this long to resolve;
+    # stragglers past it are failed loudly (ServerClosed) and counted
+    # in serve.drain_abandoned so a supervising parent can see them in
+    # the final telemetry flush.
+    drain_timeout_s: float = 60.0
+    # Seeds the loadgen hedge-delay ring and burst schedule so
+    # SOAK-REPRO lines and bench runs replay deterministically.
+    loadgen_seed: int = 0
 
     def __post_init__(self):
         # Knob validation AT CONFIG TIME with the flag named (the
@@ -558,6 +566,15 @@ class ServeConfig:
         _check("--deadline-batch-ms", self.deadline_batch_ms,
                0.0, 86_400_000.0,
                "batch-class default deadline; 0 = none")
+        _check("--drain-timeout-s", self.drain_timeout_s, 0.1, 86_400.0,
+               "SIGTERM drain budget before stragglers fail loudly")
+        _check("--loadgen-seed", self.loadgen_seed, 0, 2**63 - 1,
+               "seeds the hedge-delay ring and burst schedule")
+        if not isinstance(self.loadgen_seed, int):
+            raise ValueError(
+                f"bad serve config: --loadgen-seed={self.loadgen_seed!r} "
+                "— expected an integer seed (deterministic replay needs "
+                "an exact value)")
 
 
 @dataclass

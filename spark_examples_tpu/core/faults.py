@@ -83,6 +83,19 @@ Sites instrumented in production code:
                             last-good snapshot readable (tmp+rename),
                             ``truncate`` corrupts the current file
                             until the flush's own rename restores it
+``controller.scrape``       per replica scrape in the fleet
+                            controller's watch loop (fleet/
+                            controller.py) — ``io_error`` blackholes
+                            the /metrics endpoint: the slot must act
+                            on its last-good snapshot marked stale,
+                            then declare the replica lost only after
+                            stale_scrapes consecutive failures
+``controller.spawn``        per replica spawn (bootstrap, respawn,
+                            scale-up) in the fleet controller —
+                            ``io_error`` is a spawn-failure cascade:
+                            the slot must back off exponentially and
+                            the flap breaker must park it rather than
+                            spawn-loop
 ==========================  ====================================================
 
 Env grammar (``;``-separated specs, ``:``-separated fields)::
@@ -126,6 +139,8 @@ SITES = (
     "prefetch.transfer_wait",
     "supervisor.heartbeat",
     "telemetry.flush",
+    "controller.scrape",
+    "controller.spawn",
 )
 
 # Distinctive exit code for the "kill" kind so tests can tell an injected

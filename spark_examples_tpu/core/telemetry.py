@@ -166,6 +166,20 @@ NAMES: dict[str, tuple[str, str]] = {
         "ring rewrite (tmp+rename both, so a kill mid-flush leaves the "
         "last-good snapshot readable)",
     ),
+    "controller.step": (
+        "span",
+        "one fleet-controller control round (fleet/controller.py): "
+        "watch every replica slot (crash/hang/stale-scrape "
+        "classification), run the autoscale rules, publish gauges, and "
+        "rewrite the atomic controller.json incident ledger",
+    ),
+    "controller.spawn": (
+        "span",
+        "one replica spawned by the fleet controller (bootstrap, "
+        "respawn after a loss, scale-up, or preemption respawn) "
+        "including its warm-set staging — the time-to-ready cost the "
+        "scale-up bench measures (args: slot, reason)",
+    ),
     # -- instant events ---------------------------------------------------
     "fault": ("event", "a fault-injection spec fired (args: site, kind)"),
     "stream.snapshot": (
@@ -389,6 +403,65 @@ NAMES: dict[str, tuple[str, str]] = {
         "primary was the straggler; the loser future is cancelled) — "
         "hedge_wins / hedge_launched is the tail-latency relief rate",
     ),
+    "fleet.failovers": (
+        "counter",
+        "hedged-client re-admissions after a replica loss: a request "
+        "refused or failed with ServerClosed (kill, preemption, drain) "
+        "is re-sent to the hedge partner instead of erroring — the "
+        "zero-lost-admitted-requests contract exercised (latency paid, "
+        "answer kept)",
+    ),
+    "serve.drain_abandoned": (
+        "counter",
+        "admitted requests still queued when the SIGTERM drain budget "
+        "(--drain-timeout-s) expired — failed loudly with ServerClosed, "
+        "never dropped; read from the final telemetry flush by a "
+        "supervising parent to judge whether a drain was clean",
+    ),
+    "controller.scrapes": (
+        "counter",
+        "successful replica /metrics (or in-process stats) scrapes by "
+        "the fleet controller — the denominator against "
+        "controller.scrape_stale for scrape-path health",
+    ),
+    "controller.scrape_stale": (
+        "counter",
+        "controller scrape attempts that failed (blackholed endpoint, "
+        "parse error, injected controller.scrape fault): the slot "
+        "keeps acting on its last-good snapshot marked stale until "
+        "stale_scrapes consecutive failures declare the replica lost",
+    ),
+    "controller.respawns": (
+        "counter",
+        "replicas respawned by the controller after a loss (crash/"
+        "hang/stale) or preemption — each lands after the slot's "
+        "bounded exponential backoff, and too many inside the flap "
+        "window park the slot instead",
+    ),
+    "controller.scale_ups": (
+        "counter",
+        "replicas added by the autoscale rule: sustained interactive "
+        "queue depth per ready replica (or worst-route p99) over "
+        "pressure_rounds consecutive control rounds",
+    ),
+    "controller.retires": (
+        "counter",
+        "replicas retired by the autoscale rule after idle_rounds "
+        "consecutive all-idle rounds — SIGTERM drain within "
+        "--drain-timeout-s, hedging covers the window",
+    ),
+    "controller.preemptions": (
+        "counter",
+        "graceful preemptions handled (preempt(): drain within budget "
+        "+ immediate respawn, no backoff — the platform's fault, not "
+        "the replica's)",
+    ),
+    "controller.incidents": (
+        "counter",
+        "incidents appended to the controller's atomic controller.json "
+        "ledger (crash/hang/stale losses, spawn failures, flap-breaker "
+        "trips, dirty drains, placement overflow)",
+    ),
     "serve.priority.preemptions": (
         "counter",
         "dequeues where an interactive request jumped ahead of an "
@@ -507,6 +580,25 @@ NAMES: dict[str, tuple[str, str]] = {
         "batch-class admission queue depth — deep-and-draining is the "
         "designed steady state under mixed load (backfill absorbs the "
         "slack the interactive class leaves)",
+    ),
+    "controller.replicas": (
+        "gauge",
+        "replica slots currently up under the fleet controller "
+        "(spawned and not lost/retired/parked) — the autoscale loop's "
+        "actuated value, between min_replicas and max_replicas",
+    ),
+    "controller.ready": (
+        "gauge",
+        "up replicas whose latest fresh scrape reported ready (worker "
+        "alive, not draining, warm set staged — the /readyz rule); "
+        "ready < replicas marks a warmup or degradation window",
+    ),
+    "controller.flap_breaker_open": (
+        "gauge",
+        "replica slots parked by the flap breaker (more than "
+        "flap_max_respawns respawns inside flap_window_s): a crash-"
+        "looping slot stops burning spawns until an operator "
+        "reset_flap_breaker() — nonzero demands attention",
     ),
     "store.cache_bytes": (
         "gauge",

@@ -1,0 +1,49 @@
+"""Fleet control plane: the replica controller over serve replicas.
+
+The serve layer (serve/) is one process; this package is the layer
+that owns MANY of them — spawning, watching, draining, and scaling a
+pool of serve replicas against the autoscale signals they already
+export on ``GET /metrics``:
+
+- :mod:`~spark_examples_tpu.fleet.replica` — the replica handle: a
+  transport-decoupled :class:`ReplicaSnapshot` built either from a
+  real Prometheus ``/metrics`` scrape (subprocess replicas) or
+  directly from an in-process :class:`FleetRouter` (tests, soak,
+  bench), plus the process lifecycle (heartbeat files, SIGTERM drain,
+  TERM->KILL escalation — core/supervisor.py idiom).
+- :mod:`~spark_examples_tpu.fleet.placement` — first-fit-decreasing
+  bin packing of panel bytes against per-replica warm-pool budgets:
+  which replica keeps which panel warm.
+- :mod:`~spark_examples_tpu.fleet.controller` — the control loop:
+  crash/hang/stale-scrape detection, bounded-backoff respawn with a
+  flap breaker, sustained-pressure scale-up, idle drain-retire,
+  graceful preemption, an atomic incident ledger
+  (``controller.json``), and ``controller.*`` telemetry.
+"""
+
+from spark_examples_tpu.fleet.controller import (
+    ControllerConfig,
+    FleetController,
+)
+from spark_examples_tpu.fleet.placement import Placement, pack
+from spark_examples_tpu.fleet.replica import (
+    LocalReplica,
+    ProcessReplica,
+    Replica,
+    ReplicaSnapshot,
+    ScrapeError,
+    parse_prometheus,
+)
+
+__all__ = [
+    "ControllerConfig",
+    "FleetController",
+    "LocalReplica",
+    "Placement",
+    "ProcessReplica",
+    "Replica",
+    "ReplicaSnapshot",
+    "ScrapeError",
+    "pack",
+    "parse_prometheus",
+]

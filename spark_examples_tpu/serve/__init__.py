@@ -53,6 +53,7 @@ from spark_examples_tpu.serve.health import (
     CircuitBreaker,
 )
 from spark_examples_tpu.serve.loadgen import (
+    BurstSchedule,
     run_fleet_loadgen,
     run_hedged_loadgen,
     run_loadgen,
@@ -67,6 +68,7 @@ from spark_examples_tpu.serve.server import (
 )
 
 __all__ = [
+    "BurstSchedule",
     "CircuitBreaker",
     "DEGRADED",
     "DRAINING",

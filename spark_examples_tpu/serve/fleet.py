@@ -229,6 +229,7 @@ def build_fleet(manifest: FleetManifest, cfg: ServeConfig,
             PRIORITY_CLASSES[0]: cfg.deadline_interactive_ms / 1e3,
             PRIORITY_CLASSES[1]: cfg.deadline_batch_ms / 1e3,
         },
+        drain_timeout_s=cfg.drain_timeout_s,
     )
     for spec in manifest.routes:
         router.add_route(

@@ -47,6 +47,19 @@ def publish(state: str) -> None:
     telemetry.gauge_set("serve.health", float(HEALTH_CODE[state]))
 
 
+def readiness(worker_alive: bool, draining: bool,
+              unstaged=()) -> bool:
+    """THE readiness rule, shared by both serve fronts (``/readyz``):
+    ready iff the batching worker is alive, admission is open, and
+    every explicitly warmed route is staged (``unstaged`` empty — the
+    single-model server passes none; its panel staged before
+    construction). Readiness is deliberately narrower than liveness:
+    a degraded replica is still ready (it serves), a warming or
+    draining one is not (the controller must not route hedges at
+    it)."""
+    return bool(worker_alive and not draining and not list(unstaged))
+
+
 def worst(states) -> str:
     """The most severe of several member states — the fleet's health
     fold: one route serving cached-only (breaker open) degrades the

@@ -9,6 +9,12 @@ requests — HTTP concurrency IS the micro-batch source. Endpoints:
   Errors map onto status codes the envelope semantics imply: 429
   overloaded (shed), 503 draining, 504 deadline, 400 malformed.
 - ``GET /healthz`` — liveness + in-flight/backlog counts.
+- ``GET /readyz`` — readiness (the controller's admission gate): 200
+  once the batching worker is alive, the server is not draining, and
+  — on the fleet front — every explicitly warmed route is staged;
+  503 with the evidence otherwise. Liveness and readiness diverge on
+  purpose: a replica staging its warm set is alive but must not take
+  hedged traffic yet.
 - ``GET /stats`` — the coherent operator payload
   (:meth:`ProjectionServer.stats_payload`): request accounting,
   latency digest, the full health-machine view (status, breaker
@@ -87,6 +93,10 @@ def _make_handler(pserver: ProjectionServer):
                     "max_batch": pserver.max_batch,
                 })
                 return
+            if self.path == "/readyz":
+                info = pserver.ready_info()
+                self._reply(200 if info["ready"] else 503, info)
+                return
             if self.path == "/stats":
                 self._reply(200, pserver.stats_payload())
                 return
@@ -130,7 +140,8 @@ def _make_fleet_handler(fleet):
     single-model handler plus route addressing — ``POST /project``
     takes ``route`` (and optional ``priority``) in the body, or the
     route rides the path as ``POST /project/<route>``; ``GET /routes``
-    lists the registry with per-route stats."""
+    lists the registry with per-route stats; ``GET /warm/<route>``
+    stages a route's panel now (the controller's placement push)."""
     from spark_examples_tpu.serve.pool import PanelUnavailable
     from spark_examples_tpu.serve.router import UnknownRoute
 
@@ -149,6 +160,25 @@ def _make_fleet_handler(fleet):
         def do_GET(self):  # noqa: N802 (stdlib API)
             if self.path == "/healthz":
                 self._reply(200, fleet.health_info())
+                return
+            if self.path == "/readyz":
+                info = fleet.ready_info()
+                self._reply(200 if info["ready"] else 503, info)
+                return
+            if self.path.startswith("/warm/"):
+                # The controller's placement push: stage this route's
+                # panel now so /readyz flips ready before traffic.
+                name = self.path[len("/warm/"):]
+                try:
+                    fleet.warm_route(name)
+                except UnknownRoute as e:
+                    self._reply(404, {"error": str(e)})
+                except PanelUnavailable as e:
+                    self._reply(503, {"error": str(e)})
+                except Exception as e:
+                    self._reply(500, {"error": repr(e)})
+                else:
+                    self._reply(200, {"warmed": name})
                 return
             if self.path == "/stats":
                 self._reply(200, fleet.stats_payload())

@@ -44,6 +44,7 @@ from spark_examples_tpu.core.config import DEFAULT_PRIORITY
 from spark_examples_tpu.serve.server import (
     DeadlineExceeded,
     ProjectionServer,
+    ServerClosed,
     ServerOverloaded,
 )
 
@@ -245,14 +246,22 @@ def run_fleet_loadgen(fleet, pools: dict[str, np.ndarray],
 class _HedgeDelay:
     """Rolling p95 of completed primary latencies (shared by all
     clients of one hedged run) — the hedge trigger. Until enough
-    samples exist the caller's floor delay applies."""
+    samples exist the caller's floor delay applies; passing ``seed``
+    pre-charges the ring with a deterministic floor-scale prior so the
+    first hedge decisions replay identically run to run (SOAK-REPRO)
+    instead of depending on which client's warmup sample lands
+    first."""
 
     def __init__(self, floor_s: float, window: int = 256,
-                 min_samples: int = 20):
+                 min_samples: int = 20, seed: int | None = None):
         self.floor_s = float(floor_s)
         self._ring: deque[float] = deque(maxlen=window)
         self._min = int(min_samples)
         self._lock = threading.Lock()
+        if seed is not None:
+            rng = np.random.default_rng(int(seed))
+            for x in rng.uniform(0.8, 1.5, size=self._min):
+                self._ring.append(self.floor_s * float(x))
 
     def record(self, latency_s: float) -> None:
         with self._lock:
@@ -268,13 +277,86 @@ class _HedgeDelay:
         return max(self.floor_s, p95)
 
 
+class BurstSchedule:
+    """Seeded diurnal/bursty arrival schedule — the controller bench's
+    traffic shape, deterministic under ``--loadgen-seed``.
+
+    The instantaneous offered rate is a diurnal sinusoid over
+    ``duration_s`` (one full day compressed into the run) times a
+    ``burst_factor`` inside ``n_bursts`` seeded burst windows — the
+    scale-up trigger the controller must answer. ``arrivals()``
+    realises it as a sorted tuple of request-start offsets via a
+    seeded non-homogeneous Poisson draw, so two runs with the same
+    seed offer bit-identical traffic (the SOAK-REPRO contract's
+    precondition for pinning served coordinates across a recovery)."""
+
+    def __init__(self, duration_s: float, base_qps: float,
+                 seed: int = 0, diurnal_amplitude: float = 0.3,
+                 n_bursts: int = 2, burst_factor: float = 6.0,
+                 burst_len_s: float | None = None):
+        def _check(flag, value, lo, hi, why):
+            if not (isinstance(value, (int, float))
+                    and lo <= value <= hi):
+                raise ValueError(
+                    f"bad burst schedule: {flag}={value!r} — expected "
+                    f"a number in [{lo}, {hi}] ({why})")
+
+        _check("duration_s", duration_s, 1e-3, 86_400.0,
+               "the run's wall-clock span")
+        _check("base_qps", base_qps, 1e-6, 1e9,
+               "the diurnal baseline offered rate")
+        _check("diurnal_amplitude", diurnal_amplitude, 0.0, 0.99,
+               "sinusoid swing around the baseline")
+        _check("n_bursts", n_bursts, 0, 1000,
+               "seeded burst windows inside the run")
+        _check("burst_factor", burst_factor, 1.0, 1e6,
+               "rate multiplier inside a burst window")
+        self.duration_s = float(duration_s)
+        self.base_qps = float(base_qps)
+        self.seed = int(seed)
+        self.diurnal_amplitude = float(diurnal_amplitude)
+        self.burst_factor = float(burst_factor)
+        self.burst_len_s = float(
+            burst_len_s if burst_len_s is not None
+            else self.duration_s / 10.0)
+        rng = np.random.default_rng(self.seed)
+        starts = np.sort(rng.uniform(
+            0.0, max(1e-9, self.duration_s - self.burst_len_s),
+            size=int(n_bursts)))
+        self.bursts = tuple(
+            (float(s), float(s + self.burst_len_s)) for s in starts)
+
+    def rate_at(self, t: float) -> float:
+        rate = self.base_qps * (
+            1.0 + self.diurnal_amplitude
+            * np.sin(2.0 * np.pi * t / self.duration_s))
+        for lo, hi in self.bursts:
+            if lo <= t < hi:
+                rate *= self.burst_factor
+                break
+        return float(max(rate, 1e-9))
+
+    def arrivals(self) -> tuple[float, ...]:
+        """The realised offsets: thinning-free sequential draw — each
+        gap is exponential at the rate where the previous request
+        landed. Deterministic for a given (seed, shape)."""
+        rng = np.random.default_rng(self.seed + 1)
+        out, t = [], 0.0
+        while True:
+            t += float(rng.exponential(1.0 / self.rate_at(t)))
+            if t >= self.duration_s:
+                return tuple(out)
+            out.append(t)
+
+
 def run_hedged_loadgen(replicas, pool: np.ndarray,
                        clients: int = 4, requests_per_client: int = 50,
                        route: str | None = None,
                        priority: str = DEFAULT_PRIORITY,
                        hedge_floor_s: float = 0.01,
                        deadline_s: float | None = None,
-                       result_timeout_s: float = 60.0) -> dict:
+                       result_timeout_s: float = 60.0,
+                       seed: int | None = None) -> dict:
     """Closed-loop load with client-side request hedging between two
     (or more) replicas. ``replicas[0]`` is every client's primary; a
     request unanswered after the p95-derived hedge delay is re-sent to
@@ -286,7 +368,13 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
 
     Replica processes share the content-addressed store as their cold
     tier, so a hedge landing on a cold replica pays at worst one
-    re-stage — which is exactly the tail the hedge exists to cut."""
+    re-stage — which is exactly the tail the hedge exists to cut.
+
+    The zero-loss contract (the controller's chaos proof leans on it):
+    a replica lost mid-traffic costs latency, never an answer — a
+    request refused or failed with :class:`ServerClosed` (the loss/
+    drain signal) is re-admitted on the client's hedge partner and
+    counted in ``failovers``/``fleet.failovers``, not in ``errors``."""
     if len(replicas) < 2:
         raise ValueError("hedging needs >= 2 replicas")
     pool = np.ascontiguousarray(pool, dtype=np.int8)
@@ -300,28 +388,56 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
     tallies = [_ClientTally() for _ in range(clients)]
     hists = [telemetry.Histogram() for _ in range(clients)]
     hedges = [[0, 0] for _ in range(clients)]  # [launched, wins]
-    delay = _HedgeDelay(hedge_floor_s)
+    failovers = [0] * clients
+    delay = _HedgeDelay(hedge_floor_s, seed=seed)
     start = threading.Barrier(clients + 1)
 
     def client(c: int) -> None:
         tally, hist = tallies[c], hists[c]
+        backup_replica = replicas[1 + (c % (len(replicas) - 1))]
         start.wait()
         for k in range(requests_per_client):
             q = pool[(c + k * clients) % len(pool)]
             tally.attempts += 1
             t0 = time.perf_counter()
+
+            def _finish() -> None:
+                dt = time.perf_counter() - t0
+                tally.ok += 1
+                hist.record(dt)
+                delay.record(dt)
+
+            def _failover() -> None:
+                # The primary was lost/drained: re-admit on the hedge
+                # partner — latency, never a lost admitted request.
+                failovers[c] += 1
+                telemetry.count("fleet.failovers")
+                try:
+                    fut = _submit(backup_replica, q)
+                    fut.result(timeout=result_timeout_s)
+                except Exception:
+                    tally.errors += 1
+                    return
+                _finish()
+
             try:
                 primary = _submit(replicas[0], q)
+            except ServerClosed:
+                _failover()
+                continue
             except Exception:
                 tally.errors += 1
                 continue
             hedge_after = delay.delay_s()
             try:
                 primary.result(timeout=hedge_after)
-                dt = time.perf_counter() - t0
-                tally.ok += 1
-                hist.record(dt)
-                delay.record(dt)
+                _finish()
+                continue
+            except ServerClosed:
+                # Admitted, then the replica died out from under it
+                # (kill/preempt mid-flight): the survivor still owes
+                # the answer.
+                _failover()
                 continue
             except Exception:
                 # done-with-exception = a real failure (shed, deadline,
@@ -334,9 +450,8 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
             # Primary is the straggler: hedge to the next replica.
             hedges[c][0] += 1
             telemetry.count("fleet.hedge_launched")
-            backup = replicas[1 + (c % (len(replicas) - 1))]
             try:
-                hedge = _submit(backup, q)
+                hedge = _submit(backup_replica, q)
             except Exception:
                 hedge = None
             futs = [f for f in (primary, hedge) if f is not None]
@@ -356,22 +471,43 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
                 tally.errors += 1
                 continue
             loser = primary if winner is hedge else hedge
-            if loser is not None:
-                loser.cancel()  # queued loser drops at pickup
             try:
                 winner.result(timeout=result_timeout_s)
+            except ServerClosed:
+                # The winning leg was on a dying replica. The other
+                # leg (if any) may still answer; else re-admit.
+                salvaged = False
+                if loser is not None:
+                    try:
+                        loser.result(timeout=result_timeout_s)
+                        salvaged = True
+                    except Exception:
+                        salvaged = False
+                if salvaged:
+                    if loser is hedge:
+                        hedges[c][1] += 1
+                        telemetry.count("fleet.hedge_wins")
+                    _finish()
+                else:
+                    _failover()
+                continue
             except Exception:
+                if loser is not None:
+                    loser.cancel()
                 tally.errors += 1
                 continue
+            # Cancelled only AFTER the winner resolved: a queued loser
+            # drops at batch pickup; one already running finishes and
+            # is ignored — but it stays claimable while the winner
+            # could still turn out to sit on a dying replica.
+            if loser is not None:
+                loser.cancel()
             if winner is hedge:
                 hedges[c][1] += 1
                 telemetry.count("fleet.hedge_wins")
-            dt = time.perf_counter() - t0
-            tally.ok += 1
-            hist.record(dt)
             # The hedged request's end-to-end latency feeds the p95 too
             # — a systematically slow primary keeps the trigger honest.
-            delay.record(dt)
+            _finish()
 
     threads = [
         threading.Thread(target=client, args=(c,), daemon=True,
@@ -398,6 +534,7 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
         "completed": ok,
         "errors": sum(t.errors for t in tallies),
         "sustained_qps": round(ok / duration, 2),
+        "failovers": sum(failovers),
         "hedge_launched": launched,
         "hedge_wins": wins,
         "hedge_win_frac": round(wins / launched, 4) if launched else 0.0,

@@ -265,7 +265,8 @@ class FleetRouter:
                  max_linger_s: float = 0.002,
                  cache_entries: int = 256,
                  queue_bounds: dict[str, int] | None = None,
-                 class_deadlines_s: dict[str, float] | None = None):
+                 class_deadlines_s: dict[str, float] | None = None,
+                 drain_timeout_s: float = 60.0):
         self.routes: dict[str, Route] = {}
         self.pool = pool
         self.max_batch = int(max_batch)
@@ -274,6 +275,7 @@ class FleetRouter:
             queue_bounds
             or {cls: 64 for cls in PRIORITY_CLASSES})
         self._class_deadlines_s = dict(class_deadlines_s or {})
+        self.drain_timeout_s = float(drain_timeout_s)
         self._cache = ResultCache(cache_entries)
         self._closed = False
         self._drained = False
@@ -290,6 +292,11 @@ class FleetRouter:
         self._worker: threading.Thread | None = None
         self._worker_restarts = 0
         self._last_recovery = 0.0
+        # Routes explicitly warmed (controller placement / startup):
+        # readiness means every one of THESE is staged right now.
+        # Lazily-staged routes don't count — LRU churn on cold routes
+        # must not flap /readyz.
+        self._warmed: set[str] = set()
 
     # -- route admin -------------------------------------------------------
 
@@ -310,6 +317,7 @@ class FleetRouter:
             route = self.routes.pop(name, None)
             if route is None:
                 return False
+            self._warmed.discard(name)
             self.pool.remove(name)
             evicted = self._cache.evict_namespace(route.cache_ns)
             if evicted:
@@ -326,6 +334,7 @@ class FleetRouter:
         with self._engine_lock:
             self.pool.acquire(route.name, route.stage,
                               breaker=route.breaker)
+            self._warmed.add(route.name)
         self.publish_autoscale()
 
     def _route(self, name: str) -> Route:
@@ -396,15 +405,37 @@ class FleetRouter:
             "pool": self.pool.stats(),
         }
 
+    def ready_info(self) -> dict:
+        """Readiness (vs /healthz liveness): a replica is READY when
+        its worker is alive, it is not draining, and every explicitly
+        warmed route is staged in the pool right now — the controller
+        gates admission on this so hedges never land on a replica
+        still staging its warm set. A degraded-but-serving replica is
+        ready; a warming one is not."""
+        alive = self._worker is not None and self._worker.is_alive()
+        warmed = sorted(self._warmed)
+        missing = [n for n in warmed if not self.pool.is_staged(n)]
+        return {
+            "ready": H.readiness(alive, self._closed, missing),
+            "worker_alive": alive,
+            "draining": self._closed,
+            "warmed_routes": warmed,
+            "unstaged_routes": missing,
+        }
+
     @property
     def in_flight(self) -> int:
         with self._in_flight_lock:
             return self._in_flight
 
-    def drain(self, timeout: float = 60.0) -> bool:
+    def drain(self, timeout: float | None = None) -> bool:
         """Close admission, answer everything admitted, stop the
-        worker; stragglers are failed loudly (ServerClosed), never
-        dropped. Idempotent."""
+        worker; stragglers are failed loudly (ServerClosed) and
+        counted as ``serve.drain_abandoned``, never dropped.
+        ``timeout=None`` uses the configured ``--drain-timeout-s``.
+        Idempotent."""
+        if timeout is None:
+            timeout = self.drain_timeout_s
         with self._admission_lock:
             if self._drained:
                 return self._drain_clean
@@ -423,9 +454,16 @@ class FleetRouter:
             if self._worker is not None:
                 self._worker.join(timeout=max(1.0, timeout / 2))
                 clean = clean and not self._worker.is_alive()
+            abandoned = 0
             for p in self._queues.drain_all():
+                abandoned += 1
                 self._fail(p, ServerClosed(
                     "fleet drained before this request was processed"))
+            if abandoned:
+                # The supervising parent reads this from the final
+                # telemetry flush: how many admitted requests hit the
+                # drain deadline unanswered (failed loudly, not lost).
+                telemetry.count("serve.drain_abandoned", abandoned)
         self._drained = True
         self._drain_clean = clean
         return clean

@@ -109,11 +109,13 @@ class ProjectionServer:
                  max_linger_s: float = 0.002,
                  max_queue: int = 64,
                  cache_entries: int = 256,
-                 default_deadline_s: float | None = None):
+                 default_deadline_s: float | None = None,
+                 drain_timeout_s: float = 60.0):
         self.engine = engine
         self.max_batch = engine.max_batch
         self.max_linger_s = float(max_linger_s)
         self.default_deadline_s = default_deadline_s
+        self.drain_timeout_s = float(drain_timeout_s)
         self._q: queue.Queue[_Pending] = queue.Queue(
             maxsize=max(1, int(max_queue)))
         self._cache = ResultCache(cache_entries)
@@ -208,6 +210,19 @@ class ProjectionServer:
                         else None),
         }
 
+    def ready_info(self) -> dict:
+        """Readiness (vs /healthz liveness): the single-model server is
+        ready once its batching worker is alive and it is not draining
+        — the engine's panel was staged before construction, so there
+        is no warmup window beyond worker start. Degraded-but-serving
+        is still ready."""
+        alive = self._worker is not None and self._worker.is_alive()
+        return {
+            "ready": H.readiness(alive, self._closed),
+            "worker_alive": alive,
+            "draining": self._closed,
+        }
+
     def stats_payload(self) -> dict:
         """The ``/stats`` payload — ONE coherent schema (documented in
         README "Serving"):
@@ -293,13 +308,18 @@ class ProjectionServer:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    def drain(self, timeout: float = 60.0) -> bool:
+    def drain(self, timeout: float | None = None) -> bool:
         """Close admission and wait for every in-flight request to
         resolve, then stop the worker. Returns True on a clean drain;
         on timeout (or a dead worker) the stragglers are failed with
-        ServerClosed — an admitted request is ALWAYS answered.
+        ServerClosed and counted as ``serve.drain_abandoned`` — an
+        admitted request is ALWAYS answered, and the final telemetry
+        flush tells the supervising parent how many hit the deadline.
+        ``timeout=None`` uses the configured ``--drain-timeout-s``.
         Idempotent: a second drain (e.g. close() after drain()) returns
         the first one's verdict without re-walking the shutdown."""
+        if timeout is None:
+            timeout = self.drain_timeout_s
         with self._admission_lock:
             if self._drained:
                 return self._drain_clean
@@ -319,13 +339,17 @@ class ProjectionServer:
                 clean = clean and not self._worker.is_alive()
             # Backstop: anything the worker never picked up (it died, or
             # the drain timed out) is failed loudly, never dropped.
+            abandoned = 0
             while True:
                 try:
                     p = self._q.get_nowait()
                 except queue.Empty:
                     break
+                abandoned += 1
                 self._fail(p, ServerClosed("server drained before this "
                                            "request was processed"))
+            if abandoned:
+                telemetry.count("serve.drain_abandoned", abandoned)
         self._drained = True
         self._drain_clean = clean
         return clean

@@ -1,0 +1,966 @@
+"""Fleet control plane (fleet/controller.py + placement.py +
+replica.py, ISSUE 16): warm-panel bin packing, snapshot transports
+(stats payload + Prometheus text), the controller's failure matrix
+(crash / hang / stale scrape) with bounded-backoff respawn and the
+flap breaker, autoscale up/down with the min/max floor and ceiling,
+graceful preemption, the atomic controller.json ledger, and the two
+fault sites registered this PR (controller.scrape, controller.spawn).
+
+The satellites ride here too: `/readyz` on both HTTP fronts, the
+validated `--drain-timeout-s` / `--loadgen-seed` serve flags, the
+zero-admitted-requests-lost contract across a replica kill and a
+SIGTERM drain mid-hedged-traffic, and the seeded BurstSchedule /
+hedge-delay determinism behind `--loadgen-seed`.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.core.config import (
+    PRIORITY_CLASSES,
+    ComputeConfig,
+    IngestConfig,
+    JobConfig,
+    ServeConfig,
+)
+from spark_examples_tpu.fleet import (
+    ControllerConfig,
+    FleetController,
+    LocalReplica,
+    ProcessReplica,
+    Replica,
+    ReplicaSnapshot,
+    ScrapeError,
+    pack,
+    parse_prometheus,
+)
+from spark_examples_tpu.fleet.controller import LEDGER_KEEP
+from spark_examples_tpu.fleet.placement import Placement, rebalance_needed
+from spark_examples_tpu.fleet.replica import (
+    snapshot_from_prometheus,
+    snapshot_from_stats,
+)
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.pipelines.jobs import pcoa_job, variants_pca_job
+from spark_examples_tpu.pipelines.project import pcoa_project_job
+from spark_examples_tpu.serve import (
+    DRAINING,
+    BurstSchedule,
+    FleetManifest,
+    ServerClosed,
+    build_fleet,
+    run_hedged_loadgen,
+)
+from spark_examples_tpu.serve.loadgen import _HedgeDelay
+from tests.conftest import random_genotypes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # tools/ is repo tooling, not an installed pkg
+
+BV = 128
+N, V = 12, 256
+PANEL_BYTES = N * V
+INTERACTIVE, BATCH = PRIORITY_CLASSES
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure(dir=None)
+
+
+# ---------------------------------------------------------- placement
+
+
+def test_pack_first_fit_decreasing_and_lookups():
+    p = pack({"big": 70, "mid": 40, "small": 20},
+             {"r0": 100, "r1": 60})
+    assert p.assignments["r0"] == ("big", "small")
+    assert p.assignments["r1"] == ("mid",)
+    assert p.overflow == ()
+    assert p.replica_for("mid") == "r1"
+    assert p.replica_for("nope") is None
+    assert p.routes_for("r0") == ("big", "small")
+    assert p.routes_for("ghost") == ()
+
+
+def test_pack_overflow_and_determinism():
+    p = pack({"a": 80, "b": 80, "c": 80}, {"r0": 100, "r1": 100})
+    assert p.overflow == ("c",)  # equal sizes tie-break by name
+    # Same inputs -> bit-identical packing (the rebalance no-op rule).
+    assert pack({"a": 80, "b": 80, "c": 80},
+                {"r0": 100, "r1": 100}) == p
+    # Negative/zero sizes clamp instead of corrupting budgets.
+    q = pack({"z": -5}, {"r0": 0})
+    assert q.assignments["r0"] == ("z",)
+
+
+def test_rebalance_needed_tracks_membership_and_growth():
+    panels = {"a": 60, "b": 30}
+    budgets = {"r0": 100}
+    current = pack(panels, budgets)
+    assert not rebalance_needed(current, panels, budgets)
+    assert rebalance_needed(current, panels, {"r0": 100, "r1": 100})
+    assert rebalance_needed(current, {"a": 60, "b": 50}, {"r0": 100})
+    assert rebalance_needed(Placement(), panels, budgets)
+
+
+# ----------------------------------------------------------- snapshots
+
+
+def _stats_payload(qi=0, qb=0, in_flight=0, p99_ms=12.0, admitted=9,
+                   shed=1):
+    return {
+        "health": {"status": "healthy", "worker_alive": True,
+                   "in_flight": in_flight},
+        "queues": {INTERACTIVE: qi, BATCH: qb},
+        "pool": {"budget_bytes": 1000, "resident_bytes": 700,
+                 "pressure": 0.7, "staged_routes": ["ibs"]},
+        "routes": {
+            "ibs": {
+                "staged": True, "queue_depth": qi,
+                "admitted": admitted, "shed": shed,
+                "latency_ms": {
+                    INTERACTIVE: {"p99": p99_ms},
+                    BATCH: {"p99": p99_ms / 2},
+                },
+            },
+        },
+    }
+
+
+def test_snapshot_from_stats_maps_the_autoscale_signals():
+    snap = snapshot_from_stats(_stats_payload(qi=5, qb=2, in_flight=1),
+                               t=3.0, ready=True)
+    assert snap.ready and snap.worker_alive
+    assert snap.queue_interactive == 5 and snap.queue_batch == 2
+    assert snap.in_flight == 1
+    assert snap.p99_s == pytest.approx(0.012)
+    assert snap.shed_rate == pytest.approx(0.1)
+    assert snap.pool_bytes == 700.0
+    assert snap.pool_pressure == pytest.approx(0.7)
+    assert snap.routes["ibs"]["staged"] is True
+    assert not snap.idle and not snap.stale
+    stale = snap.as_stale()
+    assert stale.stale and stale.queue_interactive == 5
+
+    idle = snapshot_from_stats(_stats_payload(qi=0, qb=0, in_flight=0),
+                               t=4.0, ready=True)
+    assert idle.idle
+
+
+def test_parse_prometheus_skips_garbage_lines():
+    flat = parse_prometheus(
+        "# HELP x y\n"
+        "# TYPE x gauge\n"
+        "fleet_pool_bytes 700\n"
+        "serve_in_flight 2\n"
+        "not-a-number-line abc\n"
+        "  \n"
+        "loneword\n"
+        "fleet_route_r_ibs_p99_s 0.034\n")
+    assert flat == {"fleet_pool_bytes": 700.0, "serve_in_flight": 2.0,
+                    "fleet_route_r_ibs_p99_s": 0.034}
+
+
+def test_snapshot_from_prometheus_unmangles_route_series():
+    flat = {
+        "serve_in_flight": 1.0,
+        "serve_priority_depth_interactive": 4.0,
+        "serve_priority_depth_batch": 7.0,
+        "fleet_pool_bytes": 600.0,
+        "fleet_pool_pressure": 0.6,
+        "fleet_route_r_ibs_p99_s": 0.05,
+        "fleet_route_r_ibs_shed_rate": 0.25,
+        "fleet_route_r_ibs_staged": 1.0,
+        "fleet_route_r_ibs_queue_depth": 3.0,
+    }
+    snap = snapshot_from_prometheus(flat, ["r-ibs"], t=1.0, ready=True)
+    assert snap.queue_interactive == 4 and snap.queue_batch == 7
+    assert snap.p99_s == pytest.approx(0.05)
+    assert snap.shed_rate == pytest.approx(0.25)
+    assert snap.routes["r-ibs"]["staged"] is True
+    assert snap.routes["r-ibs"]["queue_depth"] == 3
+    assert snap.pool_bytes == 600.0
+
+
+# ------------------------------------------------------ config contract
+
+
+def test_controller_config_validation_names_the_knob():
+    with pytest.raises(ValueError, match="max_replicas"):
+        ControllerConfig(min_replicas=4, max_replicas=2)
+    with pytest.raises(ValueError, match="interval_s"):
+        ControllerConfig(interval_s=0.0)
+    with pytest.raises(ValueError, match="backoff_max_s"):
+        ControllerConfig(backoff_initial_s=2.0, backoff_max_s=1.0)
+    with pytest.raises(ValueError, match="--drain-timeout-s"):
+        ControllerConfig(drain_timeout_s=0.0)
+    with pytest.raises(ValueError, match="stale_scrapes"):
+        ControllerConfig(stale_scrapes=True)  # bools are not numbers
+
+
+def test_serve_config_drain_and_seed_flags_validated():
+    cfg = ServeConfig(drain_timeout_s=5.0, loadgen_seed=42)
+    assert cfg.drain_timeout_s == 5.0 and cfg.loadgen_seed == 42
+    with pytest.raises(ValueError, match="--drain-timeout-s"):
+        ServeConfig(drain_timeout_s=0.0)
+    with pytest.raises(ValueError, match="--loadgen-seed"):
+        ServeConfig(loadgen_seed=-1)
+    with pytest.raises(ValueError, match="--loadgen-seed"):
+        ServeConfig(loadgen_seed=1.5)
+
+
+# --------------------------------------------- the controller, faked out
+
+
+def _snap(ready=True, qi=0, qb=0, in_flight=0, p99=0.0):
+    return ReplicaSnapshot(
+        t=0.0, ready=ready, health="healthy", worker_alive=True,
+        in_flight=in_flight, queue_interactive=qi, queue_batch=qb,
+        p99_s=p99, shed_rate=0.0, pool_bytes=0.0, pool_pressure=0.0)
+
+
+class FakeReplica(Replica):
+    """A scriptable replica: the controller's failure matrix without
+    engines, sockets, or clocks."""
+
+    def __init__(self, name, generation=0, budget_bytes=1000):
+        self.name = name
+        self.generation = generation
+        self.budget_bytes = budget_bytes
+        self.warm_routes = ()
+        self.snap = _snap()
+        self.scrape_exc = None
+        self.warm_exc = None
+        self.hb_age = None
+        self.dead = False
+        self.killed = False
+        self.drain_calls = []
+        self.drain_clean = True
+        self.warm_calls = []
+
+    def start(self):
+        return self
+
+    def alive(self):
+        return not self.dead and not self.killed
+
+    def heartbeat_age_s(self):
+        return self.hb_age
+
+    def ready(self):
+        return self.snap.ready
+
+    def scrape(self):
+        if self.scrape_exc is not None:
+            raise self.scrape_exc
+        return self.snap
+
+    def warm(self, routes):
+        if self.warm_exc is not None:
+            raise self.warm_exc
+        self.warm_routes = tuple(routes)
+        self.warm_calls.append(tuple(routes))
+
+    def drain(self, timeout_s):
+        self.drain_calls.append(timeout_s)
+        self.dead = True
+        return self.drain_clean
+
+    def kill(self):
+        self.killed = True
+
+
+class Harness:
+    """Controller + injected clock + scripted factory."""
+
+    def __init__(self, ledger=None, **cfg_kw):
+        self.clk = [0.0]
+        self.made = []
+        self.fail_spawns = 0
+        self.warm_fail_next = False
+        defaults = dict(
+            min_replicas=2, max_replicas=3, idle_rounds=10_000,
+            pressure_rounds=2, stale_scrapes=2, hang_heartbeat_s=5.0,
+            backoff_initial_s=0.5, backoff_max_s=4.0,
+            flap_window_s=100.0, flap_max_respawns=3,
+            drain_timeout_s=7.0, ledger_path=ledger,
+        )
+        defaults.update(cfg_kw)
+        # Budgets fit exactly one route per replica: a -> slot 0,
+        # b -> slot 1 (FFD with 1000-byte budgets).
+        self.ctrl = FleetController(
+            self._factory, {"a": 600, "b": 500},
+            ControllerConfig(**defaults), clock=lambda: self.clk[0])
+
+    def _factory(self, name, generation):
+        if self.fail_spawns > 0:
+            self.fail_spawns -= 1
+            raise RuntimeError("spawn denied by harness")
+        r = FakeReplica(name, generation)
+        if self.warm_fail_next:
+            self.warm_fail_next = False
+            r.warm_exc = RuntimeError("warm denied by harness")
+        self.made.append(r)
+        return r
+
+    def tick(self, dt=1.0):
+        self.clk[0] += dt
+        return self.ctrl.step()
+
+
+def test_bootstrap_spawns_min_replicas_with_placement(tmp_path):
+    ledger = str(tmp_path / "controller.json")
+    h = Harness(ledger=ledger)
+    h.ctrl.start()
+    assert len(h.ctrl.replicas()) == 2
+    # FFD placement handed each replica its warm set at spawn.
+    assert h.made[0].warm_routes == ("a",)
+    assert h.made[1].warm_routes == ("b",)
+    with open(ledger) as f:
+        led = json.load(f)
+    assert [s["state"] for s in led["slots"]] == ["up", "up"]
+    h.ctrl.close()
+    with open(ledger) as f:
+        led = json.load(f)
+    assert [s["state"] for s in led["slots"]] == ["retired", "retired"]
+    assert h.made[0].drain_calls == [7.0]  # the configured drain budget
+
+
+def test_crash_backs_off_then_respawns():
+    h = Harness()
+    h.ctrl.start()
+    h.made[0].dead = True
+    h.tick()
+    desc = h.ctrl.describe()
+    assert desc["slots"][0]["state"] == "backoff"
+    assert any(x["kind"] == "crash" for x in desc["incidents"])
+    assert len(h.ctrl.replicas()) == 1
+    h.tick(0.1)  # inside the 0.5s backoff: still down
+    assert h.ctrl.describe()["slots"][0]["state"] == "backoff"
+    h.tick(0.5)  # past it: respawned, next generation
+    assert h.ctrl.describe()["slots"][0]["state"] == "up"
+    assert len(h.ctrl.replicas()) == 2
+    assert h.made[-1].generation == 1
+    assert h.made[-1].warm_routes == ("a",)  # placement survives death
+    assert telemetry.counter_value("controller.respawns") == 1
+    assert any(d["action"] == "respawn" for d in
+               h.ctrl.describe()["decisions"])
+
+
+def test_hang_is_killed_then_respawned():
+    h = Harness()
+    h.ctrl.start()
+    h.made[1].hb_age = 9.0  # budget is 5s
+    h.tick()
+    assert h.made[1].killed  # TERM'd the zombie before respawning
+    desc = h.ctrl.describe()
+    assert desc["slots"][1]["state"] == "backoff"
+    assert any(x["kind"] == "hang" for x in desc["incidents"])
+    h.tick(1.0)
+    assert len(h.ctrl.replicas()) == 2
+
+
+def test_stale_scrape_serves_last_good_then_declares_lost():
+    h = Harness()  # stale_scrapes=2
+    h.ctrl.start()
+    h.made[0].snap = _snap(qi=3)
+    h.tick()  # a good scrape lands the snapshot
+    h.made[0].scrape_exc = ScrapeError("blackholed /metrics")
+    h.tick()
+    desc = h.ctrl.describe()
+    # First failure: still up, acting on last-good-marked-stale.
+    assert desc["slots"][0]["state"] == "up"
+    assert desc["slots"][0]["stale"] is True
+    assert telemetry.counter_value("controller.scrape_stale") == 1
+    h.tick()  # second consecutive failure: the budget is spent
+    desc = h.ctrl.describe()
+    assert desc["slots"][0]["state"] == "backoff"
+    assert h.made[0].killed
+    assert any(x["kind"] == "stale" for x in desc["incidents"])
+    assert telemetry.counter_value("controller.scrapes") >= 1
+
+
+def test_startup_grace_tolerates_unscrapable_fresh_replica():
+    """A process replica binds its scrape port seconds after spawn:
+    failed scrapes on a never-scraped replica inside startup_grace_s
+    are startup, not loss — but an expired grace declares loss on the
+    next round (a replica that never comes up is not grandfathered)."""
+    h = Harness(startup_grace_s=10.0)  # stale_scrapes=2
+    h.ctrl.start()
+    for r in h.made:  # unscrapable from birth (still binding)
+        r.scrape_exc = ScrapeError("connection refused")
+    h.tick()
+    h.tick()  # 2 failures > stale_scrapes, but inside the grace
+    desc = h.ctrl.describe()
+    assert desc["slots"][0]["state"] == "up"
+    assert not h.made[0].killed
+    assert telemetry.counter_value("controller.scrape_stale") >= 2
+    h.made[0].scrape_exc = None  # slot 0 comes up late but fine
+    h.tick()
+    assert h.ctrl.describe()["slots"][0]["stale"] is False
+    h.tick(11.0)  # slot 1 never answers: grace expired -> lost
+    desc = h.ctrl.describe()
+    assert desc["slots"][1]["state"] == "backoff"
+    assert h.made[1].killed
+    assert any(x["kind"] == "stale" for x in desc["incidents"])
+    with pytest.raises(ValueError, match="startup_grace_s"):
+        ControllerConfig(startup_grace_s=-1.0)
+
+
+def test_flap_breaker_parks_a_dying_slot_and_resets():
+    h = Harness(backoff_initial_s=0.0, flap_max_respawns=3,
+                flap_window_s=1000.0)
+    h.ctrl.start()
+    for _ in range(10):
+        if h.ctrl.describe()["slots"][0]["state"] == "parked":
+            break
+        for r in h.made:
+            if r.name == "replica-0":
+                r.dead = True
+        h.tick()
+    desc = h.ctrl.describe()
+    assert desc["slots"][0]["state"] == "parked"
+    assert any(x["kind"] == "flap_breaker" for x in desc["incidents"])
+    assert telemetry._gauges["controller.flap_breaker_open"]["last"] == 1.0
+    # Parked stays parked — no spawn loop.
+    made_before = len(h.made)
+    h.tick()
+    h.tick()
+    assert len(h.made) == made_before
+    # Operator override: reset, next round respawns.
+    assert h.ctrl.reset_flap_breaker("replica-0") is True
+    assert h.ctrl.reset_flap_breaker("replica-0") is False
+    h.tick()
+    assert h.ctrl.describe()["slots"][0]["state"] == "up"
+    assert len(h.ctrl.replicas()) == 2
+
+
+def test_scale_up_needs_sustained_pressure_and_respects_ceiling():
+    h = Harness()  # pressure_rounds=2, max_replicas=3
+    h.ctrl.start()
+    for r in h.made:
+        r.snap = _snap(qi=10)  # depth/ready = 10 >= trigger 4
+    h.tick()
+    assert len(h.ctrl.replicas()) == 2  # one round is not sustained
+    h.tick()
+    assert len(h.ctrl.replicas()) == 3
+    assert telemetry.counter_value("controller.scale_ups") == 1
+    assert any(d["action"] == "scale_up"
+               for d in h.ctrl.describe()["decisions"])
+    # Ceiling: pressure continues, no fourth replica.
+    for r in h.made:
+        r.snap = _snap(qi=10)
+    h.tick()
+    h.tick()
+    h.tick()
+    assert len(h.ctrl.replicas()) == 3
+
+
+def test_idle_retire_drains_lifo_down_to_the_floor():
+    h = Harness(min_replicas=1, max_replicas=2, pressure_rounds=1,
+                idle_rounds=2)
+    h.ctrl.start()  # floor 1: starts one replica
+    h.made[0].snap = _snap(qi=10)
+    h.tick()  # pressure_rounds=1: scale to 2
+    assert len(h.ctrl.replicas()) == 2
+    for r in h.made:
+        r.snap = _snap(qi=0)
+    h.tick()
+    assert len(h.ctrl.replicas()) == 2  # idle 1 round of 2
+    h.tick()
+    assert len(h.ctrl.replicas()) == 1  # newest drained (LIFO)
+    retired = h.ctrl.describe()["slots"][1]
+    assert retired["state"] == "retired"
+    assert h.made[-1].drain_calls == [7.0]
+    assert telemetry.counter_value("controller.retires") == 1
+    # The floor holds no matter how long the idle stretch runs.
+    for _ in range(5):
+        h.tick()
+    assert len(h.ctrl.replicas()) == 1
+
+
+def test_preempt_drains_within_budget_and_respawns_immediately():
+    h = Harness()
+    h.ctrl.start()
+    victim = h.made[0]
+    assert h.ctrl.preempt("replica-0") is True
+    assert victim.drain_calls == [7.0]
+    # No backoff: the slot came straight back up, next generation.
+    assert len(h.ctrl.replicas()) == 2
+    assert h.ctrl.describe()["slots"][0]["state"] == "up"
+    assert h.made[-1].generation == 1
+    assert telemetry.counter_value("controller.preemptions") == 1
+    assert h.ctrl.preempt("replica-99") is False
+    # A drain past its budget is an incident, not a hang.
+    h.made[-1].drain_clean = False
+    assert h.ctrl.preempt("replica-0") is True
+    assert any(x["kind"] == "dirty_preempt"
+               for x in h.ctrl.describe()["incidents"])
+
+
+def test_spawn_failure_backs_off_and_tears_down_half_starts():
+    h = Harness()
+    h.fail_spawns = 1
+    h.ctrl.start()  # slot 0's bootstrap spawn fails
+    desc = h.ctrl.describe()
+    assert desc["slots"][0]["state"] == "backoff"
+    assert any(x["kind"] == "spawn_failure" for x in desc["incidents"])
+    assert len(h.ctrl.replicas()) == 1
+    h.tick(1.0)  # past the backoff: healed
+    assert len(h.ctrl.replicas()) == 2
+    # A replica that started but failed to warm must not leak its
+    # worker: the controller kills the half-start.
+    h.warm_fail_next = True
+    cur = next(r for r in h.made
+               if r.name == "replica-0" and r.alive())
+    cur.dead = True
+    h.tick()         # crash detected
+    h.tick(1.0)      # respawn attempt -> warm fails
+    half = h.made[-1]
+    assert half.warm_exc is not None and half.killed
+    assert h.ctrl.describe()["slots"][0]["state"] == "backoff"
+    h.tick(2.0)      # doubled backoff elapsed: healed for real
+    assert len(h.ctrl.replicas()) == 2
+
+
+def test_ledger_is_atomic_json_and_bounded(tmp_path):
+    ledger = str(tmp_path / "controller.json")
+    h = Harness(ledger=ledger, backoff_initial_s=0.0,
+                flap_max_respawns=10_000, flap_window_s=0.5)
+    h.ctrl.start()
+    # A crash costs two ticks (detect, respawn) — run enough cycles
+    # that the incident stream overflows the ledger's retention.
+    for _ in range(2 * LEDGER_KEEP + 60):
+        h.made[-1].dead = True
+        h.tick()
+    with open(ledger) as f:
+        led = json.load(f)  # parses after every rewrite: atomic
+    assert len(led["incidents"]) <= LEDGER_KEEP
+    assert len(led["decisions"]) <= LEDGER_KEEP
+    assert led["rounds"] == h.ctrl.rounds
+    assert telemetry.counter_value("controller.incidents") > LEDGER_KEEP
+    h.ctrl.close()
+
+
+def test_step_survives_a_bad_round_in_run_loop():
+    h = Harness(interval_s=0.01)
+    h.ctrl.start()
+    h.made[0].scrape_exc = RuntimeError("not a ScrapeError")
+    h.ctrl.run()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            h.clk[0] += 0.01
+            if any(x["kind"] == "step_error"
+                   for x in h.ctrl.describe()["incidents"]):
+                break
+            time.sleep(0.005)
+    finally:
+        h.ctrl.close()
+    assert any(x["kind"] == "step_error"
+               for x in h.ctrl.describe()["incidents"])
+
+
+# ----------------------------------------------------- the fault sites
+
+
+def test_controller_scrape_fault_marks_stale_then_recovers():
+    h = Harness(stale_scrapes=3)
+    h.ctrl.start()
+    h.tick()  # good scrapes land last-good snapshots
+    with faults.armed(["controller.scrape:io_error:after=0:max=1"],
+                      seed=11) as inj:
+        h.tick()
+        assert inj.fire_count("controller.scrape") == 1
+    desc = h.ctrl.describe()
+    assert desc["slots"][0]["stale"] is True
+    assert desc["slots"][0]["state"] == "up"  # within the budget
+    assert telemetry.counter_value("controller.scrape_stale") == 1
+    h.tick()  # disarmed: the next scrape clears the failure streak
+    assert h.ctrl.describe()["slots"][0]["stale"] is False
+    assert len(h.ctrl.replicas()) == 2
+
+
+def test_controller_spawn_fault_cascade_backs_off_and_heals():
+    h = Harness(backoff_initial_s=0.5)
+    with faults.armed(["controller.spawn:io_error:after=0:max=1"],
+                      seed=11) as inj:
+        h.ctrl.start()  # first spawn eats the injected failure
+        assert inj.fire_count("controller.spawn") == 1
+        desc = h.ctrl.describe()
+        assert desc["slots"][0]["state"] == "backoff"
+        assert any(x["kind"] == "spawn_failure"
+                   for x in desc["incidents"])
+        assert len(h.ctrl.replicas()) == 1
+        h.tick(1.0)  # still armed (max=1 spent): respawn succeeds
+        assert len(h.ctrl.replicas()) == 2
+
+
+def test_soak_registers_controller_scenarios_and_thread_prefix():
+    """Satellite 6: the soak's scenario table carries the controller
+    sites and the thread-hygiene table knows the controller loop's
+    thread family (graftlint parses _SUSPECT_THREADS for prefixes)."""
+    from tools.soak import _SUSPECT_THREADS, SCENARIOS
+
+    jobs = {j for j, *_ in SCENARIOS}
+    assert "controller" in jobs
+    sites = {site for j, site, *_ in SCENARIOS if j == "controller"}
+    assert sites == {"controller.scrape", "controller.spawn",
+                     "fleet.stage"}
+    assert "fleet-controller" in _SUSPECT_THREADS
+
+
+# --------------------------------------------- real replicas + readiness
+
+
+@pytest.fixture(scope="module")
+def fx(tmp_path_factory):
+    """Two fitted routes (ibs PCoA + shared-alt PCA) over one
+    compacted store — the controller integration panel."""
+    from spark_examples_tpu.store.writer import compact
+
+    base = tmp_path_factory.mktemp("controller_fixture")
+    rng = np.random.default_rng(42)
+    g = random_genotypes(rng, n=N, v=V, missing_rate=0.1)
+    store = str(base / "store")
+    compact(store, ArraySource(g), chunk_variants=64)
+    routes = {}
+    for name, fit, metric in (("r-ibs", pcoa_job, "ibs"),
+                              ("r-pca", variants_pca_job, None)):
+        model = str(base / f"model_{name}.npz")
+        job = JobConfig(
+            ingest=IngestConfig(block_variants=BV),
+            compute=ComputeConfig(metric=metric, num_pc=3),
+            model_path=model,
+        )
+        fit(job, source=ArraySource(g))
+        routes[name] = SimpleNamespace(
+            name=name, genotypes=g, store=store, model=model, job=job)
+    return SimpleNamespace(base=base, routes=routes, genotypes=g)
+
+
+def _build(fx, budget_mb=1.0, cfg=None):
+    manifest = FleetManifest.parse({
+        "budget_mb": budget_mb,
+        "routes": [
+            {"name": r.name, "model": r.model,
+             "source": f"store:{r.store}"}
+            for r in fx.routes.values()
+        ],
+    })
+    return build_fleet(
+        manifest, cfg or ServeConfig(cache_entries=0),
+        ingest_defaults=IngestConfig(block_variants=BV,
+                                     readahead_chunks=0))
+
+
+def _offline(route, query):
+    return pcoa_project_job(
+        route.job.replace(model_path=None), model_path=route.model,
+        source_new=ArraySource(query[None, :]),
+        source_ref=ArraySource(route.genotypes),
+    ).coords
+
+
+def test_router_ready_info_transitions(fx):
+    fleet = _build(fx)
+    info = fleet.ready_info()
+    assert info["ready"] is False and info["worker_alive"] is False
+    fleet.start()
+    assert fleet.ready_info()["ready"] is True
+    try:
+        fleet.warm_route("r-ibs")
+        info = fleet.ready_info()
+        assert info["ready"] is True
+        assert info["warmed_routes"] == ["r-ibs"]
+        assert info["unstaged_routes"] == []
+    finally:
+        fleet.drain()
+        info = fleet.ready_info()
+        assert info["ready"] is False and info["draining"] is True
+        fleet.close()
+
+
+def test_http_readyz_and_warm_endpoints(fx):
+    import urllib.error
+    import urllib.request
+
+    from spark_examples_tpu.serve.http import start_fleet_http_server
+
+    fleet = _build(fx).start()
+    http = start_fleet_http_server(fleet, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/readyz", timeout=30) as r:
+            body = json.loads(r.read())
+        assert r.status == 200 and body["ready"] is True
+        # /warm/<route> is the controller's staging hook.
+        with urllib.request.urlopen(f"{base}/warm/r-pca",
+                                    timeout=60) as r:
+            assert json.loads(r.read()) == {"warmed": "r-pca"}
+        assert fleet.pool.is_staged("r-pca")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/warm/nope", timeout=30)
+        assert err.value.code == 404
+        # Draining flips readiness to 503 while /healthz keeps talking.
+        fleet.drain()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/readyz", timeout=30)
+        assert err.value.code == 503
+        assert json.loads(err.value.read())["draining"] is True
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] == DRAINING
+    finally:
+        http.shutdown()
+        fleet.close()
+
+
+def test_single_model_server_readyz(fx):
+    from spark_examples_tpu.serve import ProjectionEngine, ProjectionServer
+
+    route = fx.routes["r-ibs"]
+    engine = ProjectionEngine(route.model, ArraySource(route.genotypes),
+                              block_variants=BV, max_batch=4)
+    server = ProjectionServer(engine, drain_timeout_s=5.0)
+    assert server.ready_info()["ready"] is False
+    server.start()
+    try:
+        assert server.ready_info()["ready"] is True
+    finally:
+        server.drain()  # uses the configured 5s budget
+        info = server.ready_info()
+        assert info["ready"] is False and info["draining"] is True
+        server.close()
+
+
+def _local_replica(fx, name, cfg=None):
+    return LocalReplica(
+        name, lambda: _build(fx, cfg=cfg).start(),
+        budget_bytes=2 * PANEL_BYTES)
+
+
+def test_zero_admitted_requests_lost_on_replica_kill(fx):
+    """The tentpole's chaos proof in miniature: kill the primary under
+    hedged load — every admitted request is answered (failovers, never
+    errors), and the survivor still serves bit-identical coordinates."""
+    r0 = _local_replica(fx, "replica-0").start()
+    r1 = _local_replica(fx, "replica-1").start()
+    rng = np.random.default_rng(3)
+    pool = random_genotypes(rng, n=8, v=V, missing_rate=0.1)
+    box = {}
+
+    def _drive():
+        box["report"] = run_hedged_loadgen(
+            [r0.router, r1.router], pool, clients=2,
+            requests_per_client=10, route="r-ibs",
+            hedge_floor_s=0.005, result_timeout_s=120.0, seed=5)
+
+    t = threading.Thread(target=_drive, name="loadgen-client-driver",
+                         daemon=True)
+    t.start()
+    time.sleep(0.05)
+    r0.kill()
+    t.join(timeout=120.0)
+    report = box["report"]
+    try:
+        assert report["errors"] == 0
+        assert report["completed"] == 20
+        assert report["failovers"] >= 1
+        assert not r0.alive() and r1.alive()
+        q = pool[0]
+        got = r1.router.project("r-ibs", q, timeout=120.0)
+        np.testing.assert_array_equal(
+            got, _offline(fx.routes["r-ibs"], q).astype(np.float32))
+    finally:
+        r1.drain(30.0)
+
+
+def test_sigterm_drain_with_inflight_hedged_requests(fx):
+    """Satellite 4: drain a fleet replica mid-hedged-traffic. Every
+    admitted request is answered, the drain gauge shows the state, and
+    nothing is silently dropped."""
+    slow_cfg = ServeConfig(cache_entries=0, max_linger_ms=20.0)
+    r0 = _local_replica(fx, "replica-0", cfg=slow_cfg).start()
+    r1 = _local_replica(fx, "replica-1", cfg=slow_cfg).start()
+    box = {}
+    rng = np.random.default_rng(4)
+    pool = random_genotypes(rng, n=8, v=V, missing_rate=0.1)
+
+    def _drive():
+        box["report"] = run_hedged_loadgen(
+            [r0.router, r1.router], pool, clients=2,
+            requests_per_client=8, route="r-ibs",
+            hedge_floor_s=0.01, result_timeout_s=120.0, seed=6)
+
+    t = threading.Thread(target=_drive, name="loadgen-client-driver",
+                         daemon=True)
+    t.start()
+    time.sleep(0.05)
+    clean = r0.drain(30.0)  # the SIGTERM path for a local replica
+    t.join(timeout=120.0)
+    report = box["report"]
+    try:
+        assert clean is True
+        assert report["errors"] == 0
+        assert report["completed"] == 16
+        # The drained replica advertised its state on the way down.
+        assert telemetry._gauges["serve.health"]["max"] == 2.0  # DRAINING
+        assert not r0.alive()
+    finally:
+        r1.drain(30.0)
+
+
+def test_drain_reports_requests_abandoned_at_deadline(fx):
+    """Satellite 3: requests still queued when the drain deadline
+    expires are failed loudly (ServerClosed) and counted as
+    serve.drain_abandoned — never a silent drop. A never-started
+    worker makes the straggler set exact: every admitted request hits
+    the deadline."""
+    fleet = _build(fx)  # admission open, worker never started
+    rng = np.random.default_rng(8)
+    futs = [fleet.submit("r-ibs",
+                         random_genotypes(rng, n=1, v=V,
+                                          missing_rate=0.1)[0],
+                         priority=INTERACTIVE)
+            for _ in range(6)]
+    assert fleet.drain(timeout=0.0) is False
+    for f in futs:
+        with pytest.raises(ServerClosed):
+            f.result(timeout=120.0)
+    assert telemetry.counter_value("serve.drain_abandoned") == 6
+    fleet.close()
+
+
+def test_controller_over_local_replicas_end_to_end(fx, tmp_path):
+    """The tentpole integration: bootstrap with placement, kill ->
+    respawn within the backoff budget, preempt -> drain + immediate
+    respawn, ledger tells the story, and recovered replicas serve
+    bit-identically."""
+    ledger = str(tmp_path / "controller.json")
+
+    def factory(name, generation):
+        return LocalReplica(name, lambda: _build(fx).start(),
+                            budget_bytes=2 * PANEL_BYTES,
+                            generation=generation)
+
+    ctrl = FleetController(
+        factory, {"r-ibs": PANEL_BYTES, "r-pca": PANEL_BYTES},
+        ControllerConfig(
+            min_replicas=2, max_replicas=3, idle_rounds=10_000,
+            stale_scrapes=2, backoff_initial_s=0.01, backoff_max_s=0.5,
+            flap_window_s=60.0, flap_max_respawns=10,
+            drain_timeout_s=30.0, ledger_path=ledger,
+        ))
+    try:
+        ctrl.start()
+        assert len(ctrl.replicas()) == 2
+        assert ctrl.ready_count() == 0  # no scrape yet
+        ctrl.step()
+        assert ctrl.ready_count() == 2
+        # Kill -> detect -> respawn within the backoff budget.
+        ctrl.replicas()[0].kill()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            ctrl.step()
+            reps = ctrl.replicas()
+            if len(reps) == 2 and all(r.alive() for r in reps):
+                break
+            time.sleep(0.02)
+        reps = ctrl.replicas()
+        assert len(reps) == 2 and all(r.alive() for r in reps)
+        # Preempt: drained gracefully, respawned immediately.
+        assert ctrl.preempt("replica-1") is True
+        assert len(ctrl.replicas()) == 2
+        # Bit-identity after both recoveries, on every replica.
+        rng = np.random.default_rng(9)
+        q = random_genotypes(rng, n=1, v=V, missing_rate=0.1)[0]
+        want = _offline(fx.routes["r-ibs"], q).astype(np.float32)
+        for replica in ctrl.replicas():
+            np.testing.assert_array_equal(
+                replica.router.project("r-ibs", q, timeout=120.0), want)
+    finally:
+        ctrl.close()
+    with open(ledger) as f:
+        led = json.load(f)
+    actions = {d["action"] for d in led["decisions"]}
+    assert {"respawn", "preempt"} <= actions
+    assert any(x["kind"] == "crash" for x in led["incidents"])
+
+
+# ------------------------------------------------- ProcessReplica bits
+
+
+def test_process_replica_plumbing(tmp_path):
+    from spark_examples_tpu.core import supervisor
+
+    r = ProcessReplica(
+        "replica-0", argv=["true"], workdir=str(tmp_path),
+        budget_bytes=1000, route_names=["r-ibs"])
+    assert r.argv[-2:] == ["--port-file", r.port_file]
+    assert r.env[supervisor.ENV_HEARTBEAT] == r.heartbeat_path
+    assert r.port() is None  # nothing announced yet
+    assert r.heartbeat_age_s() is None  # startup, not a hang
+    assert r.alive() is False
+    assert r.drain(1.0) is True  # never started: trivially clean
+    # Warm before the port is announced DEFERS (records intent): a
+    # spawn warms immediately after Popen, and the serve child stages
+    # panels lazily on demand anyway — raising here turned every slow
+    # process start into a spawn_failure -> flap-breaker park.
+    r.warm(("r-ibs",))
+    assert r.warm_routes == ("r-ibs",)
+    with open(r.port_file, "w") as f:
+        json.dump({"port": 4242}, f)
+    assert r.port() == 4242
+    with pytest.raises(ScrapeError, match="/metrics"):
+        r.scrape()  # nothing listening on the announced port
+    with pytest.raises(ScrapeError):
+        r.warm(("r-ibs",))  # port known: a failed warm is a failure
+
+
+# --------------------------------------------- seeded load (satellite 2)
+
+
+def test_burst_schedule_is_deterministic_and_validated():
+    a = BurstSchedule(duration_s=10.0, base_qps=5.0, seed=7)
+    b = BurstSchedule(duration_s=10.0, base_qps=5.0, seed=7)
+    assert a.bursts == b.bursts
+    assert a.arrivals() == b.arrivals()
+    c = BurstSchedule(duration_s=10.0, base_qps=5.0, seed=8)
+    assert c.arrivals() != a.arrivals()
+    assert all(0.0 < t < 10.0 for t in a.arrivals())
+    # Inside a burst window the rate is the diurnal rate times the
+    # burst factor.
+    lo, _hi = a.bursts[0]
+    base_rate = 5.0 * (1.0 + 0.3 * np.sin(2.0 * np.pi * lo / 10.0))
+    assert a.rate_at(lo) == pytest.approx(base_rate * 6.0)
+    with pytest.raises(ValueError, match="bad burst schedule"):
+        BurstSchedule(duration_s=0.0, base_qps=5.0)
+    with pytest.raises(ValueError, match="burst_factor"):
+        BurstSchedule(duration_s=1.0, base_qps=5.0, burst_factor=0.5)
+
+
+def test_hedge_delay_seed_precharges_the_ring():
+    seeded = _HedgeDelay(0.01, seed=42)
+    again = _HedgeDelay(0.01, seed=42)
+    assert seeded.delay_s() == again.delay_s()
+    assert seeded.delay_s() >= 0.01  # floor always holds
+    # Unseeded: floor until min_samples arrive (no prior to replay).
+    cold = _HedgeDelay(0.01)
+    assert cold.delay_s() == 0.01
+    # The prior drains out as real samples land: record a slow tail
+    # and the p95 takes over.
+    for _ in range(256):
+        seeded.record(0.5)
+    assert seeded.delay_s() == pytest.approx(0.5)

@@ -284,6 +284,22 @@ def test_core_names_present():
         "live.proxy_stale",
         "trend.metrics_checked",
         "trend.regressions",
+        # fleet control plane: the controller loop's evidence trail
+        # (ISSUE 16's instrumentation contract)
+        "controller.step",
+        "controller.spawn",
+        "controller.scrapes",
+        "controller.scrape_stale",
+        "controller.respawns",
+        "controller.scale_ups",
+        "controller.retires",
+        "controller.preemptions",
+        "controller.incidents",
+        "controller.replicas",
+        "controller.ready",
+        "controller.flap_breaker_open",
+        "serve.drain_abandoned",
+        "fleet.failovers",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution

@@ -77,6 +77,16 @@ def test_metric_directions_resolve_sensibly():
     # violation is a regression.
     assert d("lint_ok") == trend.BOOL_MUST_HOLD
     assert d("lint_findings") == trend.LOWER_IS_BETTER
+    # Fleet control plane (bench --controller): scaling up faster,
+    # shedding less of the burst, and a flatter p99 across a replica
+    # loss are all improvements; the chaos gate (zero admitted
+    # requests lost + respawn + scale-up observed) must hold; the
+    # equilibrium replica count is workload shape, never gated.
+    assert d("controller_scale_up_s") == trend.LOWER_IS_BETTER
+    assert d("controller_burst_shed_rate") == trend.LOWER_IS_BETTER
+    assert d("controller_p99_loss_s") == trend.LOWER_IS_BETTER
+    assert d("controller_ok") == trend.BOOL_MUST_HOLD
+    assert d("controller_replicas") is None
 
 
 # ------------------------------------------------------------------ the band

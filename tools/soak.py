@@ -70,7 +70,7 @@ from spark_examples_tpu.store.writer import compact
 # the production tree carries one of these prefixes, so a new thread
 # family that can leak must add itself here to pass tier-1.
 _SUSPECT_THREADS = ("store-readahead", "projection-serve-worker",
-                    "fleet-serve-worker",
+                    "fleet-serve-worker", "fleet-controller",
                     "supervisor-heartbeat", "telemetry-flusher",
                     "prefetch-producer", "partitioned-reader",
                     "projection-http", "live-telemetry-http",
@@ -120,6 +120,22 @@ SCENARIOS: tuple = (
     # that fails must be absorbed (warned + counted) with the job —
     # and every published snapshot — intact.
     ("gram", "telemetry.flush", "io_error", dict(after=(0, 8), max=(1, 2))),
+    # Controller rounds (fleet/controller.py): a 2-replica fleet under
+    # the control loop, each round ALSO running the deterministic
+    # chaos sequence (replica kill mid-hedged-burst -> respawn within
+    # the backoff budget with zero admitted requests lost, then a
+    # preemption storm draining every replica in turn) plus the armed
+    # site: a scrape blackhole (last-good-marked-stale until the slot
+    # is declared lost), a spawn-failure cascade (backoff, never a
+    # spawn loop), or a stage failure while a respawned replica warms
+    # its assigned panels. Bit-identity of served coordinates is
+    # pinned across every recovery.
+    ("controller", "controller.scrape", "io_error",
+     dict(after=(0, 2), max=(1, 2))),
+    ("controller", "controller.spawn", "io_error",
+     dict(after=(0, 1), max=(1, 1))),
+    ("controller", "fleet.stage", "io_error",
+     dict(after=(0, 2), max=(1, 1))),
 )
 
 KILL_SCENARIOS: tuple = (
@@ -519,6 +535,167 @@ def _run_fleet_round(fx: _Fixture, spec: str,
     return problems
 
 
+def _make_controller(fx: _Fixture, ledger_path: str):
+    """A 2-replica controller over LocalReplica fleets sharing the
+    soak store as their cold tier — every replica can serve every
+    route; the warm split comes from the controller's placement."""
+    from spark_examples_tpu.fleet import (
+        ControllerConfig,
+        FleetController,
+        LocalReplica,
+    )
+
+    panel_bytes = fx.cfg.n_samples * fx.cfg.n_variants
+    budget = int(panel_bytes * 1.5)
+
+    def factory(name, generation):
+        return LocalReplica(name, lambda: fx.make_fleet().start(),
+                            budget_bytes=budget, generation=generation)
+
+    cfg = ControllerConfig(
+        min_replicas=2, max_replicas=3,
+        idle_rounds=10_000,  # retire is not this round's subject
+        stale_scrapes=2, hang_heartbeat_s=60.0,
+        backoff_initial_s=0.01, backoff_max_s=0.5,
+        flap_window_s=60.0, flap_max_respawns=20,
+        drain_timeout_s=30.0, ledger_path=ledger_path,
+    )
+    return FleetController(factory, {"ibs": panel_bytes,
+                                     "pca": panel_bytes}, cfg)
+
+
+def _run_controller_round(fx: _Fixture, i: int, spec: str,
+                          round_seed: int) -> list[str]:
+    """One in-process controller round: the armed site (scrape
+    blackhole / spawn cascade / stage failure) plus the deterministic
+    chaos sequence every round runs — a replica kill mid-hedged-burst
+    (zero admitted requests lost, respawn within the backoff budget)
+    and a preemption storm — with served coordinates bit-identical to
+    the clean fleet baseline after every recovery, and the atomic
+    controller.json ledger readable with the story in it."""
+    from spark_examples_tpu.serve import PanelUnavailable, run_hedged_loadgen
+
+    problems: list[str] = []
+    ledger = os.path.join(fx.cfg.workdir, f"controller{i}.json")
+    ctrl = _make_controller(fx, ledger)
+    heal_budget_s = 15.0  # >> the 0.5s backoff ceiling
+
+    def _heal(why: str) -> bool:
+        # Always step at least once: a freshly killed replica stays
+        # "up" until a watch round notices the corpse.
+        deadline = time.monotonic() + heal_budget_s
+        while time.monotonic() < deadline:
+            ctrl.step()
+            reps = ctrl.replicas()
+            if len(reps) >= 2 and all(r.alive() for r in reps):
+                return True
+            time.sleep(0.02)
+        problems.append(
+            f"controller did not heal back to 2 live replicas within "
+            f"{heal_budget_s:.0f}s ({why}) — backoff budget blown "
+            f"or flap breaker mis-tripped: "
+            f"{[s.state for s in ctrl.slots]}")
+        return False
+
+    try:
+        ctrl.start()
+        with faults.armed([spec], seed=round_seed):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                # Watch rounds under the armed site: a blackholed
+                # scrape serves last-good-marked-stale until the slot
+                # is declared lost; spawn/stage failures must back
+                # off and heal — never spawn-loop, never wedge.
+                for _ in range(6):
+                    ctrl.step()
+                if not _heal("under the armed fault"):
+                    return problems
+                # Chaos 1: kill the primary mid-hedged-burst. The
+                # hedge partner + ServerClosed failover must answer
+                # every admitted request — a replica loss costs
+                # latency, never an answer.
+                routers = [r.router for r in ctrl.replicas()]
+                box: dict = {}
+
+                def _drive() -> None:
+                    box["report"] = run_hedged_loadgen(
+                        routers, fx.query_pool, clients=2,
+                        requests_per_client=8, route="ibs",
+                        hedge_floor_s=0.005, result_timeout_s=30.0,
+                        seed=round_seed)
+
+                driver = threading.Thread(
+                    target=_drive, name="loadgen-client-driver",
+                    daemon=True)
+                driver.start()
+                time.sleep(0.05)
+                ctrl.replicas()[0].kill()
+                driver.join(timeout=60.0)
+                report = box.get("report")
+                if report is None:
+                    problems.append(
+                        "hedged burst did not complete after the "
+                        "replica kill (driver hung)")
+                    return problems
+                if report["errors"]:
+                    problems.append(
+                        f"{report['errors']} request(s) lost to the "
+                        f"replica kill (failovers={report['failovers']}"
+                        ") — the zero-loss contract is broken")
+                if not _heal("after the mid-burst kill"):
+                    return problems
+                # Chaos 2: preemption storm — every replica drained
+                # and respawned in turn, gracefully.
+                for slot_name in [r.name for r in ctrl.replicas()]:
+                    if not ctrl.preempt(slot_name):
+                        problems.append(
+                            f"preempt({slot_name!r}) refused — slot "
+                            "not up when the storm reached it")
+                if not _heal("after the preemption storm"):
+                    return problems
+                # Bit-identity across every recovery: each surviving
+                # replica serves both routes exactly as the clean
+                # fleet baseline did (stage faults still armed fail
+                # explicitly, like the fleet rounds).
+                for replica in ctrl.replicas():
+                    for route in ("ibs", "pca"):
+                        for qi, q in enumerate(fx.query_pool):
+                            try:
+                                got = replica.router.project(
+                                    route, q, timeout=30.0)
+                            except (faults.InjectedFault,
+                                    PanelUnavailable):
+                                continue
+                            if not np.array_equal(
+                                    got, fx.fleet_baseline[route][qi]):
+                                problems.append(
+                                    f"{replica.name} served {route}"
+                                    f"[{qi}] differs from the clean "
+                                    "baseline after recovery")
+        # Evidence: the atomic ledger must be readable and carry the
+        # round's story.
+        try:
+            with open(ledger) as f:
+                led = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f"controller ledger unreadable ({e}) — "
+                            "the atomic-write contract is broken")
+        else:
+            acts = {d["action"] for d in led["decisions"]}
+            kinds = {x["kind"] for x in led["incidents"]}
+            if "respawn" not in acts or "preempt" not in acts:
+                problems.append(
+                    f"ledger is missing the round's decisions "
+                    f"(actions={sorted(acts)})")
+            if "crash" not in kinds:
+                problems.append(
+                    f"ledger has no crash incident for the mid-burst "
+                    f"kill (kinds={sorted(kinds)})")
+    finally:
+        ctrl.close()
+    return problems
+
+
 def _run_kill_round(fx: _Fixture, i: int, spec: str, round_seed: int,
                     baseline_tsv: bytes) -> tuple[list[str], int]:
     """One supervised subprocess round: the CLI job with an injected
@@ -629,6 +806,8 @@ def run_soak(cfg: SoakConfig) -> SoakReport:
                 problems = _run_serve_round(fx, spec, round_seed)
             elif jobkind == "fleet":
                 problems = _run_fleet_round(fx, spec, round_seed)
+            elif jobkind == "controller":
+                problems = _run_controller_round(fx, i, spec, round_seed)
             else:
                 problems, restarts = _run_kill_round(
                     fx, i, spec, round_seed, baseline_tsv)

@@ -91,6 +91,14 @@ _EXPLICIT: dict[str, int | None] = {
     "fleet_routes": None,
     "fleet_evictions": None,
     "fleet_hedge_win_frac": None,
+    # Controller bench (bench --controller): the shed fraction has no
+    # suffix rule ("_rate" is ambiguous between throughput and loss) —
+    # here it is dropped requests, so it must go DOWN; the final
+    # replica count is the workload's equilibrium, not a quality axis.
+    # scale_up_s / p99_loss_s gate through the "_s" suffix rule and
+    # controller_ok through the *_ok must-hold gate.
+    "controller_burst_shed_rate": LOWER_IS_BETTER,
+    "controller_replicas": None,
 }
 
 # (match kind, token, direction) — first hit wins, checked in order:
