@@ -1375,9 +1375,55 @@ def bench_fleet() -> dict:
             [slow, fast], pools["r-ibs"], clients=2,
             requests_per_client=10, route="r-ibs",
             hedge_floor_s=0.02, result_timeout_s=300.0)
+        # Tracing tax: the same closed-loop run with sampling off vs
+        # full sampling — the flight recorder must stay near-free on
+        # the request path (trend-gated, <= 2% is the budget).
+        sample0 = telemetry.trace_sample()
+        try:
+            telemetry.set_trace_sample(0.0)
+            t0 = time.perf_counter()
+            run_hedged_loadgen(
+                [fast, fast], pools["r-ibs"], clients=2,
+                requests_per_client=20, route="r-ibs",
+                hedge_floor_s=30.0, result_timeout_s=300.0)
+            wall_untraced = time.perf_counter() - t0
+            telemetry.set_trace_sample(1.0)
+            t0 = time.perf_counter()
+            run_hedged_loadgen(
+                [fast, fast], pools["r-ibs"], clients=2,
+                requests_per_client=20, route="r-ibs",
+                hedge_floor_s=30.0, result_timeout_s=300.0)
+            wall_traced = time.perf_counter() - t0
+        finally:
+            telemetry.set_trace_sample(sample0)
+        trace_overhead_frac = max(0.0, round(
+            (wall_traced - wall_untraced) / max(wall_untraced, 1e-9), 4))
     finally:
         slow.close()
         fast.close()
+    # SLO fast-burn on an injected latency regression: a memory-only
+    # timeline fed rounds whose route p99 is 40x the declared target
+    # must burn the fast window past its budget — the signal the
+    # controller converts into same-round scale-up (fleet/slo.py).
+    from spark_examples_tpu.fleet.replica import ReplicaSnapshot
+    from spark_examples_tpu.fleet.slo import SLOEvaluator, SLOSpec
+    from spark_examples_tpu.fleet.timeline import FleetTimeline
+
+    tl = FleetTimeline(path=None)
+    for rd in range(6):
+        snap = ReplicaSnapshot(
+            t=time.time(), ready=True, health="ready",
+            worker_alive=True, in_flight=1, queue_interactive=0,
+            queue_batch=0, p99_s=0.2, shed_rate=0.0, pool_bytes=0.0,
+            pool_pressure=0.0,
+            routes={"r-ibs": {"p99_s": 0.2, "queue_depth": 0,
+                              "shed_rate": 0.0, "staged": True}})
+        tl.record_round(rd, {"replica-0": snap}, 1, 1)
+    breaches = SLOEvaluator(
+        (SLOSpec(route="r-ibs", p99_ms=5.0, fast_window_s=30.0,
+                 slow_window_s=30.0),), tl).evaluate()
+    slo_fast_burn_ok = bool(
+        breaches and breaches[0]["fast_burn"] >= 1.0)
     p99_i = report["per_class"][PRIORITY_CLASSES[0]]["p99_s"]
     p99_b = report["per_class"][PRIORITY_CLASSES[1]]["p99_s"]
     log(f"fleet: {len(routes)} routes, sustained "
@@ -1387,7 +1433,9 @@ def bench_fleet() -> dict:
         f"bit-identical={identical}; hedged p99 "
         f"{hedged['p99_s'] * 1e3:.1f} ms vs unhedged "
         f"{unhedged['p99_s'] * 1e3:.1f} ms "
-        f"(win frac {hedged['hedge_win_frac']})")
+        f"(win frac {hedged['hedge_win_frac']}); trace overhead "
+        f"{trace_overhead_frac * 100:.1f}%, slo fast-burn trip="
+        f"{slo_fast_burn_ok}")
     return {
         "routes": len(routes),
         "panel": [n, nv],
@@ -1406,6 +1454,8 @@ def bench_fleet() -> dict:
         "hedge_win_frac": hedged["hedge_win_frac"],
         "hedge_launched": hedged["hedge_launched"],
         "hedge_errors": hedged["errors"] + unhedged["errors"],
+        "trace_overhead_frac": trace_overhead_frac,
+        "slo_fast_burn_ok": slo_fast_burn_ok,
     }
 
 
@@ -2305,6 +2355,8 @@ def main() -> None:
         headline["fleet_sustained_qps"] = fl["mix"]["sustained_qps"]
         headline["fleet_evictions"] = fl["evictions"]
         headline["fleet_hedge_win_frac"] = fl["hedge_win_frac"]
+        headline["trace_overhead_frac"] = fl["trace_overhead_frac"]
+        headline["slo_fast_burn_ok"] = fl["slo_fast_burn_ok"]
         headline["fleet_ok"] = bool(
             fl["bit_identical_vs_offline"]
             and fl["clean_drain"]

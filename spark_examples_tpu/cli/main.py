@@ -271,6 +271,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    "trace.jsonl (--no-trace-events keeps the "
                    "metrics.json export but skips the event timeline "
                    "for very long streams)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   metavar="RATE",
+                   help="request-trace sampling rate in [0, 1]: a "
+                   "served request's trace.* spans and slowest-K "
+                   "exemplar entry are kept iff its trace_id samples "
+                   "in (deterministic on the id, so every hedge leg "
+                   "and replica subprocess makes the SAME keep/drop "
+                   "decision); 1 keeps everything, 0 disables request "
+                   "tracing")
     p.add_argument("--telemetry-flush-s", type=float, default=0.0,
                    metavar="SECONDS",
                    help="publish live telemetry snapshots every this "
@@ -306,6 +315,7 @@ def _job_from_args(args) -> JobConfig:
             trace_events=args.trace_events,
             flush_s=args.telemetry_flush_s,
             live_port=args.live_port,
+            trace_sample=args.trace_sample,
         ),
         ingest=IngestConfig(
             source=args.source,
@@ -593,15 +603,34 @@ def main(argv: list[str] | None = None) -> int:
         "(attempt<a>/rank<r>/trace.jsonl from supervised restarts, "
         "rank<r>/ otherwise) into ONE Perfetto-loadable session trace "
         "on a shared wall-clock timeline, with the supervisor's "
-        "crash/hang/stall incidents as restart markers",
+        "crash/hang/stall incidents as restart markers; add --fleet "
+        "to treat <dir> as a fleet workdir (one track per replica "
+        "slot, controller-ledger incidents as markers). `telemetry "
+        "timeline --path <dir>`: render the fleet controller's "
+        "timeline.jsonl ring (route p99 / queue depth / replica-count "
+        "history with incident markers) as a JSON report + stderr "
+        "table",
     )
-    p_tel.add_argument("verb", choices=["stitch"],
+    p_tel.add_argument("verb", choices=["stitch", "timeline"],
                        help="maintenance action")
     p_tel.add_argument("--path", required=True,
-                       help="the --telemetry-dir of the job to stitch")
+                       help="the --telemetry-dir of the job to stitch, "
+                       "or (timeline / stitch --fleet) the fleet "
+                       "workdir holding timeline.jsonl / per-slot "
+                       "exports")
     p_tel.add_argument("--output", default=None,
                        help="stitched trace path (default: "
-                       "<path>/stitched_trace.jsonl)")
+                       "<path>/stitched_trace.jsonl, or "
+                       "<path>/stitched_fleet_trace.jsonl with --fleet)")
+    p_tel.add_argument("--fleet", action="store_true",
+                       help="stitch a fleet workdir: every replica "
+                       "slot's attempt/rank exports on one Perfetto "
+                       "timeline, one pid block per slot, controller "
+                       "incidents (controller.json + rotated .old) as "
+                       "global markers")
+    p_tel.add_argument("--last", type=int, default=30, metavar="N",
+                       help="timeline verb: rows rendered from the "
+                       "tail of the ring (default 30)")
 
     p_lint = sub.add_parser(
         "lint",
@@ -746,7 +775,8 @@ def main(argv: list[str] | None = None) -> int:
         if job.telemetry.dir:
             telemetry.configure(dir=job.telemetry.dir,
                                 trace_events=job.telemetry.trace_events,
-                                flush_s=job.telemetry.flush_s)
+                                flush_s=job.telemetry.flush_s,
+                                trace_sample=job.telemetry.trace_sample)
 
             def _export_telemetry():
                 d = telemetry.export()
@@ -757,6 +787,8 @@ def main(argv: list[str] | None = None) -> int:
             # LIFO: the flusher stops (one final publish) BEFORE the
             # full export writes the definitive trace.jsonl.
             stack.callback(telemetry.stop_periodic_flush)
+        else:
+            telemetry.set_trace_sample(job.telemetry.trace_sample)
         # Live introspection sidecar: the --live-port flag, or the
         # environment when a supervisor parent armed this child with
         # an ephemeral port + port file for its proxy.
@@ -1239,10 +1271,32 @@ def _run_serve_fleet(args, parser, job, cfg, build_source) -> int:
 
 
 def _run_telemetry_admin(args) -> int:
-    """The ``telemetry`` maintenance subcommand (currently: ``stitch``).
-    Prints the stitch report as JSON; exit 0 iff something stitched."""
-    from spark_examples_tpu.core.stitch import StitchError, stitch
+    """The ``telemetry`` maintenance subcommand (``stitch`` — single
+    job or ``--fleet`` — and ``timeline``). Prints the report as JSON;
+    exit 0 iff something was read."""
+    from spark_examples_tpu.core.stitch import (
+        StitchError,
+        stitch,
+        stitch_fleet,
+    )
 
+    if args.verb == "timeline":
+        return _run_telemetry_timeline(args)
+    if args.fleet:
+        try:
+            report = stitch_fleet(args.path, output=args.output)
+        except StitchError as e:
+            print(f"telemetry stitch --fleet: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(report, sort_keys=True))
+        print(
+            f"telemetry stitch --fleet: {report['events']} events "
+            f"from {len(report['slots'])} replica slot(s), "
+            f"{report['incident_markers']} incident marker(s) -> "
+            f"{report['output']} (open in https://ui.perfetto.dev)",
+            file=sys.stderr,
+        )
+        return 0
     try:
         report = stitch(args.path, output=args.output)
     except StitchError as e:
@@ -1264,6 +1318,73 @@ def _run_telemetry_admin(args) -> int:
         f"{report['output']} (open in https://ui.perfetto.dev)",
         file=sys.stderr,
     )
+    return 0
+
+
+def _run_telemetry_timeline(args) -> int:
+    """``telemetry timeline --path <dir|file>``: the fleet flight
+    recorder's read side — route p99 / queue-depth / replica-count
+    history from the controller's timeline.jsonl ring, incident and
+    decision markers interleaved where they happened."""
+    import os
+
+    from spark_examples_tpu.fleet.timeline import read_timeline
+
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "timeline.jsonl")
+    records = read_timeline(path)
+    if not records:
+        print(f"telemetry timeline: no readable records in {path!r} "
+              "(run the fleet controller with a ledger/timeline path)",
+              file=sys.stderr)
+        return 1
+    rounds = [r for r in records if r.get("type") == "round"]
+    markers = [r for r in records if r.get("type") == "marker"]
+    routes: dict[str, dict] = {}
+    for rec in rounds:
+        for s in rec.get("slots", {}).values():
+            if not s.get("present"):
+                continue
+            for name, r in s.get("routes", {}).items():
+                agg = routes.setdefault(
+                    name, {"p99_max_ms": 0.0, "p99_last_ms": 0.0})
+                p99_ms = r.get("p99_s", 0.0) * 1e3
+                agg["p99_max_ms"] = max(agg["p99_max_ms"], p99_ms)
+                agg["p99_last_ms"] = p99_ms
+    report = {
+        "path": path,
+        "rounds": len(rounds),
+        "markers": len(markers),
+        "replicas_last": rounds[-1]["replicas"] if rounds else 0,
+        "ready_last": rounds[-1]["ready"] if rounds else 0,
+        "routes": {k: {kk: round(vv, 3) for kk, vv in v.items()}
+                   for k, v in sorted(routes.items())},
+        "marker_kinds": sorted({m.get("kind", "?") for m in markers}),
+    }
+    print(json.dumps(report, sort_keys=True))
+    t0 = records[0].get("t_unix", 0.0)
+    tail = sorted(records, key=lambda r: r.get("seq", 0))[-args.last:]
+    for rec in tail:
+        dt = rec.get("t_unix", t0) - t0
+        if rec.get("type") == "round":
+            slots = [s for s in rec.get("slots", {}).values()
+                     if s.get("present")]
+            p99 = max((s.get("p99_s", 0.0) for s in slots), default=0.0)
+            depth = sum(s.get("queue_interactive", 0)
+                        + s.get("queue_batch", 0) for s in slots)
+            shed = max((s.get("shed_rate", 0.0) for s in slots),
+                       default=0.0)
+            print(f"t+{dt:7.2f}s round {rec.get('round', 0):>4} "
+                  f"replicas={rec.get('replicas', 0)} "
+                  f"ready={rec.get('ready', 0)} "
+                  f"p99={p99 * 1e3:8.1f}ms depth={depth:>3} "
+                  f"shed={shed:6.1%}", file=sys.stderr)
+        else:
+            print(f"t+{dt:7.2f}s !! [{rec.get('kind', '?')}] "
+                  f"{rec.get('who', '?')}: "
+                  f"{str(rec.get('detail', ''))[:90]}",
+                  file=sys.stderr)
     return 0
 
 

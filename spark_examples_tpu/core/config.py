@@ -455,6 +455,12 @@ class TelemetryConfig:
     trace_events: bool = True
     flush_s: float = 0.0  # 0 = export at exit only
     live_port: int | None = None  # None = no sidecar; 0 = ephemeral
+    # Detailed per-request tracing sample rate in [0, 1]: the fraction
+    # of requests (deterministic on trace_id, so hedge legs and replica
+    # subprocesses agree) that get waterfall spans + slowest-K exemplar
+    # consideration. 1.0 traces everything; steady-state fleets dial it
+    # down so tracing overhead stays negligible.
+    trace_sample: float = 1.0
 
     def __post_init__(self):
         if not (isinstance(self.flush_s, (int, float))
@@ -477,6 +483,15 @@ class TelemetryConfig:
                 f"bad telemetry config: --live-port={self.live_port!r} "
                 "— expected a TCP port in [0, 65535] (0 binds an "
                 "ephemeral port)"
+            )
+        if not (isinstance(self.trace_sample, (int, float))
+                and not isinstance(self.trace_sample, bool)
+                and 0.0 <= self.trace_sample <= 1.0):
+            raise ValueError(
+                f"bad telemetry config: --trace-sample="
+                f"{self.trace_sample!r} — expected a sample rate in "
+                "[0, 1] (the fraction of requests granted detailed "
+                "per-request tracing; 0 disables, 1 traces everything)"
             )
 
 

@@ -96,6 +96,16 @@ Sites instrumented in production code:
                             the slot must back off exponentially and
                             the flap breaker must park it rather than
                             spawn-loop
+``trace.export``            per flight-recorder artifact write: the
+                            slowest-request exemplar file (core/
+                            telemetry.py requests.json) and each fleet
+                            timeline append/compaction (fleet/
+                            timeline.py) — ``io_error`` fails one
+                            write (absorbed into trace.export_errors /
+                            timeline.write_errors, never fatal),
+                            ``truncate`` tears the timeline's tail
+                            (readers must skip the torn line and keep
+                            the last-good rounds)
 ==========================  ====================================================
 
 Env grammar (``;``-separated specs, ``:``-separated fields)::
@@ -141,6 +151,7 @@ SITES = (
     "telemetry.flush",
     "controller.scrape",
     "controller.spawn",
+    "trace.export",
 )
 
 # Distinctive exit code for the "kill" kind so tests can tell an injected

@@ -66,7 +66,9 @@ instead of raising mid-job.
 
 from __future__ import annotations
 
+import contextvars
 import functools
+import heapq
 import json
 import math
 import os
@@ -74,6 +76,7 @@ import threading
 import time
 import uuid
 import warnings
+import zlib
 from contextlib import contextmanager
 
 # ---------------------------------------------------------------------------
@@ -462,6 +465,13 @@ NAMES: dict[str, tuple[str, str]] = {
         "ledger (crash/hang/stale losses, spawn failures, flap-breaker "
         "trips, dirty drains, placement overflow)",
     ),
+    "controller.ledger_rotations": (
+        "counter",
+        "full-ledger generations rotated to controller.json.old "
+        "(atomic tmp+rename) before the bounded incident/decision "
+        "deques started dropping their oldest entries — history is "
+        "archived, never silently discarded",
+    ),
     "serve.priority.preemptions": (
         "counter",
         "dequeues where an interactive request jumped ahead of an "
@@ -746,6 +756,120 @@ NAMES: dict[str, tuple[str, str]] = {
         "aggregate-ingest number that scales with host count under the "
         "shard-aware feed",
     ),
+    # -- request tracing / fleet timeline / SLO (the flight recorder) -----
+    "trace.request": (
+        "span",
+        "one sampled request's admission-to-response wall at the HTTP "
+        "front (args: trace_id, route, class, status, cache_hit) — the "
+        "root of the per-request waterfall the stitcher renders",
+    ),
+    "trace.queue": (
+        "span",
+        "one sampled request's admission-to-batch-pickup wait inside "
+        "the router (args: trace_id, route, class) — the per-request "
+        "leg of serve.enqueue_wait_s, placed on the waterfall",
+    ),
+    "trace.compute": (
+        "span",
+        "the device-step wall attributed to one sampled request of the "
+        "executed micro-batch (args: trace_id, rows, cold_start, "
+        "stage_s — stage_s > 0 is the cold-start cost this request "
+        "paid waiting on a panel re-stage)",
+    ),
+    "trace.hedge": (
+        "event",
+        "hedge resolution for a traced request: both legs share one "
+        "trace_id with distinct span ids; args record the winning leg "
+        "(primary/hedge) and whether the loser was cancelled",
+    ),
+    "trace.sampled": (
+        "counter",
+        "requests granted detailed per-request tracing by the "
+        "--trace-sample rate (deterministic on trace_id, so every "
+        "process and both hedge legs agree on the same decision)",
+    ),
+    "trace.export_errors": (
+        "counter",
+        "slowest-request exemplar (requests.json) writes that failed "
+        "(unwritable dir, injected trace.export fault) — absorbed; the "
+        "last-good exemplar file stays readable (tmp+rename)",
+    ),
+    "trace.exemplars": (
+        "gauge",
+        "occupancy of the slowest-K request exemplar ring keyed by "
+        "trace_id (GET /debug/requests serves it; bounded at "
+        "TRACE_EXEMPLARS)",
+    ),
+    "timeline.rounds": (
+        "counter",
+        "control rounds persisted into the fleet timeline ring "
+        "(fleet/timeline.py timeline.jsonl — one line per scrape round "
+        "with every slot's ReplicaSnapshot folded in)",
+    ),
+    "timeline.markers": (
+        "counter",
+        "replica lifecycle incidents (crash/respawn/preempt/park/"
+        "scale) aligned onto the fleet timeline as markers",
+    ),
+    "timeline.compactions": (
+        "counter",
+        "timeline ring compactions: the append-only timeline.jsonl hit "
+        "its size bound and was atomically rewritten (tmp+rename) with "
+        "only the newest rounds kept",
+    ),
+    "timeline.write_errors": (
+        "counter",
+        "timeline appends/compactions that failed (full disk, injected "
+        "trace.export fault) — absorbed, the controller keeps stepping "
+        "and the last-good timeline stays readable",
+    ),
+    "timeline.bytes": (
+        "gauge",
+        "current byte size of the fleet timeline ring file (bounded by "
+        "max_bytes via compaction)",
+    ),
+    "timeline.fleet_p99_s": (
+        "gauge",
+        "fleet-wide served p99 folded across every fresh replica "
+        "snapshot this round (Histogram.merge over per-slot series; "
+        "served as fleet_timeline_fleet_p99_s on GET /fleet/metrics)",
+    ),
+    "timeline.fleet_queue_depth": (
+        "gauge",
+        "fleet-wide interactive+batch admission queue depth summed "
+        "across every fresh replica snapshot this round",
+    ),
+    "timeline.fleet_shed_rate": (
+        "gauge",
+        "worst per-replica shed rate across the fleet this round (the "
+        "load-shedding hot spot, not the average)",
+    ),
+    "timeline.route.*": (
+        "gauge",
+        "cross-replica folded per-route series, one gauge per "
+        "timeline.route.<name>.<signal>: p99_s (max across replicas), "
+        "queue_depth (sum), staged (replicas holding the panel warm) — "
+        "the fleet-wide view GET /fleet/metrics serves",
+    ),
+    "slo.breaches": (
+        "counter",
+        "SLO burn-rate breaches recorded by the controller's evaluator "
+        "(fast AND slow windows both burning): each lands as a ledger "
+        "incident and registers scale-up pressure in the same round",
+    ),
+    "slo.ok": (
+        "gauge",
+        "1 while no declared SLO is breaching, 0 while any objective's "
+        "fast+slow burn windows are both over budget",
+    ),
+    "slo.*": (
+        "gauge",
+        "per-objective burn-rate gauges, one per "
+        "slo.<route>.<class>.<window>: fast_burn / slow_burn (observed "
+        "violation fraction over the window divided by the objective's "
+        "error budget; >= 1.0 means the budget is burning at alert "
+        "rate) and breach (1 while both windows burn)",
+    ),
 }
 
 _FAMILIES = tuple(n[:-1] for n in NAMES if n.endswith(".*"))  # "phase."
@@ -937,7 +1061,8 @@ def _check_name(name: str) -> None:
 
 
 def configure(dir: str | None = None, trace_events: bool = True,
-              flush_s: float = 0.0) -> None:
+              flush_s: float = 0.0,
+              trace_sample: float | None = None) -> None:
     """Enable export (and optionally span trace events) process-wide.
 
     Metrics are always collected; this sets where :func:`export` writes
@@ -964,6 +1089,12 @@ def configure(dir: str | None = None, trace_events: bool = True,
     with _lock:
         _dir = dir
         _trace = bool(trace_events) and dir is not None
+    if trace_sample is not None:
+        set_trace_sample(trace_sample)
+        # Children (ProcessReplica, supervised ranks) inherit the rate
+        # through the environment, so one --trace-sample governs the
+        # whole process tree and sampling decisions stay consistent.
+        os.environ[ENV_TRACE_SAMPLE] = repr(_trace_sample)
     if dir is not None:
         _install_crash_flush()
     if flush_s and flush_s > 0 and dir is not None:
@@ -1038,6 +1169,7 @@ def reset() -> None:
         _hists.clear()
         _events.clear()
         _warned_names.clear()
+        _exemplars.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -1104,10 +1236,15 @@ def _append_event(ev: dict) -> None:
 
 
 def event(name: str, cat: str = "misc", **attrs) -> None:
-    """Instant event on the trace timeline (thread-scoped 'i' phase)."""
+    """Instant event on the trace timeline (thread-scoped 'i' phase).
+    An ambient request trace context (:func:`trace_scope`) stamps its
+    ids into the args unless the caller passed its own."""
     _check_name(name)
     if not _trace:
         return
+    ctx = _TRACE_CTX.get()
+    if ctx is not None:
+        attrs = {**ctx, **attrs}
     _append_event({
         "name": name,
         "cat": cat,
@@ -1119,19 +1256,165 @@ def event(name: str, cat: str = "misc", **attrs) -> None:
     })
 
 
+# ---------------------------------------------------------------------------
+# Request-scoped trace context (the flight-recorder tentpole).
+#
+# A trace_id is minted at HTTP admission (or accepted from X-Trace-Id)
+# and identifies one logical request across threads, hedge legs, and
+# process boundaries; span_ids are per-leg. The context rides a
+# contextvar so spans/events opened on the admitting thread pick the
+# ids up automatically, and explicit ``trace_id=`` attrs carry them
+# where work hops threads (the router's batch worker). Sampling is
+# DETERMINISTIC on the trace_id (crc32 threshold), so both hedge legs
+# and every replica subprocess make the same keep/drop decision for a
+# given request without coordination.
+
+_TRACE_CTX: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "spark_examples_tpu_trace_ctx", default=None)
+
+ENV_TRACE_SAMPLE = "SPARK_EXAMPLES_TPU_TRACE_SAMPLE"
+
+TRACE_EXEMPLARS = 32  # slowest-K request exemplar ring size
+
+_trace_sample = 1.0
+_exemplars: list[tuple[float, int, dict]] = []  # min-heap (total_s, seq, rec)
+_exemplar_seq = 0
+
+
+def _env_trace_sample() -> float:
+    try:
+        v = float(os.environ.get(ENV_TRACE_SAMPLE, "") or 1.0)
+    except ValueError:
+        return 1.0
+    return min(max(v, 0.0), 1.0)
+
+
+_trace_sample = _env_trace_sample()
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex request id (one per logical request; hedge legs
+    share it)."""
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    """A fresh 8-hex span id (one per leg/hop of a traced request)."""
+    return uuid.uuid4().hex[:8]
+
+
+def current_trace() -> dict | None:
+    """The ambient {trace_id, span_id} of this task, or None."""
+    return _TRACE_CTX.get()
+
+
+@contextmanager
+def trace_scope(trace_id: str | None = None, span_id: str | None = None):
+    """Bind a request trace context for the duration of the block —
+    spans begun and events emitted inside automatically carry the ids.
+    Yields the context dict (handy for X-Trace-Id echo)."""
+    ctx = {"trace_id": trace_id or new_trace_id(),
+           "span_id": span_id or new_span_id()}
+    token = _TRACE_CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _TRACE_CTX.reset(token)
+
+
+def set_trace_sample(rate: float) -> None:
+    """Set the process-wide detailed-tracing sample rate in [0, 1]
+    (the --trace-sample knob; also seeds ENV_TRACE_SAMPLE defaults in
+    replica children via the environment)."""
+    global _trace_sample
+    _trace_sample = min(max(float(rate), 0.0), 1.0)
+
+
+def trace_sample() -> float:
+    return _trace_sample
+
+
+def should_sample(trace_id: str) -> bool:
+    """Deterministic per-request sampling decision: crc32(trace_id)
+    against the configured rate — stable across threads, hedge legs,
+    and replica processes, so a sampled request is sampled everywhere
+    its trace_id travels."""
+    rate = _trace_sample
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return (zlib.crc32(trace_id.encode()) & 0xFFFFFFFF) < rate * 2**32
+
+
+def span_at(name: str, t0: float, dur: float, cat: str = "trace",
+            tid: int | None = None, **attrs) -> None:
+    """Record an already-measured interval as a completed span
+    (histogram + ph:'X' trace event with explicit start/duration).
+    The retroactive form per-request waterfall legs need: the router's
+    batch worker knows a request's queue wait only at pickup time and
+    its compute share only after the device step returns."""
+    _check_name(name)
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = Histogram()
+        h.record(dur)
+    if _trace:
+        _append_event({
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": (t0 - _T0) * 1e6,
+            "dur": dur * 1e6,
+            "tid": threading.get_ident() if tid is None else tid,
+            "args": attrs,
+        })
+
+
+def record_request_exemplar(trace_id: str, total_s: float,
+                            phases: dict, **attrs) -> None:
+    """Keep this request in the slowest-K exemplar ring if it is slow
+    enough (min-heap on total latency). Keyed by trace_id; served by
+    GET /debug/requests and exported as requests.json."""
+    global _exemplar_seq
+    rec = {"trace_id": trace_id, "total_s": total_s,
+           "phases": dict(phases), "t_unix": time.time(), **attrs}
+    with _lock:
+        _exemplar_seq += 1
+        if len(_exemplars) < TRACE_EXEMPLARS:
+            heapq.heappush(_exemplars, (total_s, _exemplar_seq, rec))
+        elif total_s > _exemplars[0][0]:
+            heapq.heapreplace(_exemplars, (total_s, _exemplar_seq, rec))
+        else:
+            return
+        n = len(_exemplars)
+    gauge_set("trace.exemplars", float(n))
+
+
+def request_exemplars() -> list[dict]:
+    """The exemplar ring, slowest first."""
+    with _lock:
+        items = sorted(_exemplars, key=lambda e: (-e[0], e[1]))
+    return [dict(rec) for _t, _s, rec in items]
+
+
 class SpanHandle:
     """An open span: :meth:`end` records it (histogram + trace event),
     :meth:`cancel` drops it. Explicit handles let loop bodies time the
     full block *period* (producer wait included) without contorting the
     iteration into a context manager."""
 
-    __slots__ = ("name", "cat", "t0", "tid", "_open")
+    __slots__ = ("name", "cat", "t0", "tid", "trace", "_open")
 
     def __init__(self, name: str, cat: str):
         self.name = name
         self.cat = cat
         self.t0 = time.perf_counter()
         self.tid = threading.get_ident()
+        # Captured at open: the span may END on another thread (or
+        # after the request scope unwound) and must keep its ids.
+        self.trace = _TRACE_CTX.get()
         self._open = True
 
     def end(self, **attrs) -> float:
@@ -1146,6 +1429,8 @@ class SpanHandle:
                 h = _hists[self.name] = Histogram()
             h.record(dur)
         if _trace:
+            if self.trace is not None:
+                attrs = {**self.trace, **attrs}
             _append_event({
                 "name": self.name,
                 "cat": self.cat,
@@ -1415,6 +1700,28 @@ def _rank_dir(base: str) -> str:
     return os.path.join(base, f"rank{rank}")
 
 
+def _export_exemplars(d: str) -> None:
+    """``requests.json``: the slowest-K request exemplar ring, written
+    atomically next to metrics.json. The ``trace.export`` fault site
+    fires here (and at the fleet timeline's writes) so the chaos
+    harness can prove a torn exemplar write leaves the last-good file
+    readable; failures are absorbed into ``trace.export_errors`` — a
+    trace artifact must never fail the process it describes."""
+    from spark_examples_tpu.core import faults  # circular at module load
+
+    ex = request_exemplars()
+    if not ex:
+        return
+    path = os.path.join(d, "requests.json")
+    try:
+        faults.fire("trace.export", path=path)
+        _atomic_write(path, json.dumps(
+            {"exemplars": ex, "trace_sample": _trace_sample,
+             "meta": _meta(0)}, indent=1, sort_keys=True, default=str))
+    except OSError:
+        count("trace.export_errors")
+
+
 class PeriodicFlusher:
     """Daemon thread atomically republishing ``metrics.json`` plus a
     rolling ``live_trace.jsonl`` ring every ``interval_s`` — the
@@ -1462,6 +1769,7 @@ class PeriodicFlusher:
                     os.path.join(d, "live_trace.jsonl"),
                     (json.dumps({**ev, "pid": rank}, default=str)
                      for ev in recent_events()))
+                _export_exemplars(d)
             count("live.flushes")
         except BaseException as e:
             count("live.flush_errors")
@@ -1560,6 +1868,7 @@ def _export(base: str) -> str:
     snap["meta"] = _meta(len(events))
     _atomic_write(os.path.join(d, "metrics.json"),
                   json.dumps(snap, indent=1, sort_keys=True, default=str))
+    _export_exemplars(d)
 
     if rank == 0:
         try:

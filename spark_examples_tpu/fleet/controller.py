@@ -45,6 +45,7 @@ the ``fleet-controller`` daemon thread for production use.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -52,6 +53,8 @@ from dataclasses import dataclass, field
 
 from spark_examples_tpu.core import faults, telemetry
 from spark_examples_tpu.fleet import placement as P
+from spark_examples_tpu.fleet import slo as SLO
+from spark_examples_tpu.fleet import timeline as TL
 from spark_examples_tpu.fleet.replica import Replica, ScrapeError
 
 # Literal-name tables (the telemetry-name lint bans f-string names).
@@ -95,6 +98,14 @@ class ControllerConfig:
     # covers this window for interactive traffic).
     drain_timeout_s: float = 30.0
     ledger_path: str | None = None
+    # Fleet flight recorder: the per-round timeline ring lands beside
+    # the ledger (timeline_path=None derives <ledger dir>/timeline.jsonl
+    # when a ledger is configured; memory-only otherwise), and declared
+    # SLOs (fleet/slo.py SLOSpec tuple, usually parsed from the fleet
+    # manifest) are burn-rate-evaluated over it every round.
+    timeline_path: str | None = None
+    timeline_max_bytes: int = TL.DEFAULT_MAX_BYTES
+    slos: tuple = ()
 
     def __post_init__(self):
         def _check(flag, value, lo, hi, why):
@@ -140,6 +151,20 @@ class ControllerConfig:
                "respawns inside the window before the slot is parked")
         _check("--drain-timeout-s", self.drain_timeout_s, 0.1, 86400.0,
                "graceful drain budget for retire/preempt")
+        if not (isinstance(self.timeline_max_bytes, int)
+                and not isinstance(self.timeline_max_bytes, bool)
+                and self.timeline_max_bytes >= TL._MIN_MAX_BYTES):
+            raise ValueError(
+                f"bad controller config: --timeline-max-bytes="
+                f"{self.timeline_max_bytes!r} — expected an int >= "
+                f"{TL._MIN_MAX_BYTES} (the timeline ring compacts past "
+                "this size)")
+        for s in self.slos:
+            if not isinstance(s, SLO.SLOSpec):
+                raise ValueError(
+                    f"bad controller config: slos={self.slos!r} — "
+                    "expected a tuple of fleet.slo.SLOSpec (parse the "
+                    "manifest's 'slos' list with fleet.slo.parse_slos)")
 
 
 @dataclass
@@ -190,6 +215,19 @@ class FleetController:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._closed = False
+        # The flight recorder: timeline ring beside the ledger (or
+        # memory-only), plus per-round SLO burn evaluation over it.
+        tl_path = self.cfg.timeline_path
+        if tl_path is None and self.cfg.ledger_path:
+            tl_path = os.path.join(
+                os.path.dirname(os.path.abspath(self.cfg.ledger_path)),
+                "timeline.jsonl")
+        self.timeline = TL.FleetTimeline(
+            path=tl_path, max_bytes=self.cfg.timeline_max_bytes)
+        self._slo = SLO.SLOEvaluator(tuple(self.cfg.slos), self.timeline)
+        self._slo_pressure = False
+        self._since_rotate = 0
+        self._metrics_server: TL.TimelineMetricsServer | None = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -235,6 +273,9 @@ class FleetController:
     def close(self) -> None:
         """Stop the loop and drain every live replica."""
         self.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server = None
         with self._lock:
             if self._closed:
                 return
@@ -244,6 +285,18 @@ class FleetController:
                     slot.replica.drain(self.cfg.drain_timeout_s)
                     slot.state = "retired"
         self._write_ledger()
+
+    def serve_metrics(self, host: str = "127.0.0.1", port: int = 0,
+                      port_file: str | None = None
+                      ) -> TL.TimelineMetricsServer:
+        """Start (idempotently) the controller's own metrics surface:
+        ``GET /fleet/metrics`` Prometheus text with the cross-replica
+        ``timeline.*``/``slo.*`` folds, ``GET /fleet/timeline`` JSON."""
+        if self._metrics_server is None:
+            self._metrics_server = TL.TimelineMetricsServer(
+                self.timeline, host=host, port=port,
+                port_file=port_file).serve_in_thread()
+        return self._metrics_server
 
     # -- introspection -----------------------------------------------------
 
@@ -295,6 +348,7 @@ class FleetController:
                 now = self.clock()
                 for slot in self.slots:
                     self._watch_slot(slot, now)
+                self._observe()
                 self._autoscale(now)
             self._publish()
             self._write_ledger()
@@ -354,6 +408,27 @@ class FleetController:
         slot.scrape_failures = 0
         slot.last_snapshot = snap
 
+    def _observe(self) -> None:
+        """The flight-recorder phase of every round: persist this
+        round's per-slot snapshots into the timeline ring, evaluate
+        the declared SLOs' burn windows over it, and ledger breaches —
+        which also arm scale-up pressure for THIS round's autoscale
+        (a breach bypasses the sustained pressure_rounds gate)."""
+        slots = {s.name: (s.last_snapshot if s.state == "up" else None)
+                 for s in self.slots if s.state != "retired"}
+        up = sum(1 for s in self.slots if s.state == "up")
+        self.timeline.record_round(self.rounds, slots, up,
+                                   self.ready_count())
+        breaches = self._slo.evaluate()
+        self._slo_pressure = bool(breaches)
+        for b in breaches:
+            self._incident(
+                b["key"], "slo_breach",
+                f"{b['objective']} burned: fast {b['fast_burn']}x / "
+                f"slow {b['slow_burn']}x over budget "
+                f"(windows {b['fast_window_s']:g}s/"
+                f"{b['slow_window_s']:g}s)")
+
     def _autoscale(self, now: float) -> None:
         up = [s for s in self.slots if s.state == "up"]
         snaps = [s.last_snapshot for s in up
@@ -376,18 +451,25 @@ class FleetController:
         self._idle_rounds = self._idle_rounds + 1 if idle else 0
         active = [s for s in self.slots
                   if s.state in ("up", "down", "backoff")]
-        if (self._pressure_rounds >= self.cfg.pressure_rounds
+        if ((self._pressure_rounds >= self.cfg.pressure_rounds
+                or self._slo_pressure)
                 and len(active) < self.cfg.max_replicas):
             slot = _Slot(index=len(self.slots))
             self.slots.append(slot)
-            self._decide("scale_up", slot.name,
-                         f"interactive depth/ready={per_ready:.1f} "
-                         f"(trigger {self.cfg.scale_up_depth}), worst "
-                         f"p99={p99 * 1e3:.1f}ms, sustained "
-                         f"{self._pressure_rounds} rounds")
+            why = (f"interactive depth/ready={per_ready:.1f} "
+                   f"(trigger {self.cfg.scale_up_depth}), worst "
+                   f"p99={p99 * 1e3:.1f}ms, sustained "
+                   f"{self._pressure_rounds} rounds")
+            if self._slo_pressure:
+                # An SLO breach IS the pressure signal — it already
+                # proved sustained burn over its fast+slow windows, so
+                # it does not wait out pressure_rounds again.
+                why = "slo breach pressure (this round); " + why
+            self._decide("scale_up", slot.name, why)
             self._spawn(slot, reason="scale_up")
             self._rebalance("scale_up")
             self._pressure_rounds = 0
+            self._slo_pressure = False
         elif (self._idle_rounds >= self.cfg.idle_rounds
               and len(up) > self.cfg.min_replicas):
             slot = up[-1]  # newest first out: LIFO keeps slot 0 warm
@@ -573,13 +655,16 @@ class FleetController:
     # -- evidence ----------------------------------------------------------
 
     def _incident(self, who: str, kind: str, detail: str) -> None:
+        self._rotate_ledger_if_full(self.incidents)
         self.incidents.append({
             "round": self.rounds, "who": who, "kind": kind,
             "detail": detail, "t_unix": time.time(),
         })
         telemetry.count("controller.incidents")
+        self.timeline.record_marker(self.rounds, who, kind, detail)
 
     def _decide(self, action: str, who: str, detail: str) -> None:
+        self._rotate_ledger_if_full(self.decisions)
         self.decisions.append({
             "round": self.rounds, "action": action, "who": who,
             "detail": detail, "t_unix": time.time(),
@@ -587,6 +672,27 @@ class FleetController:
         counter = _DECISION_COUNTERS.get(action)
         if counter:
             telemetry.count(counter)
+        self.timeline.record_marker(self.rounds, who, action, detail)
+
+    def _rotate_ledger_if_full(self, dq: deque) -> None:
+        """The ledger deques are bounded at LEDGER_KEEP: before a full
+        deque drops its oldest entry, snapshot the whole current ledger
+        to ``<ledger>.old`` (tmp+rename, the checkpoint idiom) — one
+        rotation covers the next LEDGER_KEEP drops, so history rolls
+        into generations instead of silently vanishing."""
+        if len(dq) < LEDGER_KEEP or not self.cfg.ledger_path:
+            return
+        if self._since_rotate > 0:
+            self._since_rotate -= 1
+            return
+        try:
+            telemetry._atomic_write(
+                self.cfg.ledger_path + ".old",
+                json.dumps(self.describe(), indent=1, sort_keys=True))
+            telemetry.count("controller.ledger_rotations")
+        except OSError:
+            pass  # evidence is best-effort; the loop must keep going
+        self._since_rotate = LEDGER_KEEP - 1
 
     def _publish(self) -> None:
         with self._lock:

@@ -315,7 +315,7 @@ class ProcessReplica(Replica):
                  budget_bytes: int, route_names: list[str],
                  env: dict | None = None, generation: int = 0,
                  scrape_timeout_s: float = 2.0):
-        from spark_examples_tpu.core import supervisor
+        from spark_examples_tpu.core import supervisor, telemetry
 
         self.name = name
         self.budget_bytes = int(budget_bytes)
@@ -329,6 +329,14 @@ class ProcessReplica(Replica):
         self.argv = list(argv) + ["--port-file", self.port_file]
         self.env = dict(os.environ if env is None else env)
         self.env[supervisor.ENV_HEARTBEAT] = self.heartbeat_path
+        # Trace continuity across the process boundary: the child
+        # stamps the SAME run_id into its exported trace events (so
+        # `telemetry stitch --fleet` joins them onto one timeline) and
+        # makes the SAME deterministic keep/drop sampling decision for
+        # any trace_id the parent forwarded.
+        self.env.setdefault(telemetry.ENV_RUN_ID, telemetry.run_id())
+        self.env.setdefault(telemetry.ENV_TRACE_SAMPLE,
+                            repr(telemetry.trace_sample()))
         self.proc: subprocess.Popen | None = None
         self._port: int | None = None
 

@@ -68,6 +68,9 @@ class FleetManifest:
     budget_mb: float | None = None
     max_batch: int | None = None
     block_variants: int | None = None
+    # Declared objectives (fleet/slo.py SLOSpec): the controller
+    # burn-rate-evaluates these over the fleet timeline every round.
+    slos: tuple = ()
 
     @classmethod
     def parse(cls, doc: dict, origin: str = "<manifest>") -> "FleetManifest":
@@ -119,7 +122,7 @@ class FleetManifest:
                 block_variants=r.get("block_variants"),
             ))
         unknown_top = set(doc) - {"routes", "budget_mb", "max_batch",
-                                  "block_variants"}
+                                  "block_variants", "slos"}
         if unknown_top:
             raise FleetFormatError(
                 f"fleet manifest {origin}: unknown top-level field(s) "
@@ -149,11 +152,21 @@ class FleetManifest:
                     f"({spec.name!r}) block_variants={bv!r} — expected "
                     "an integer >= 1"
                 )
+        slos: tuple = ()
+        if doc.get("slos") is not None:
+            from spark_examples_tpu.fleet import slo as SLO
+
+            def _err(msg: str) -> FleetFormatError:
+                return FleetFormatError(
+                    f"fleet manifest {origin}: {msg}")
+
+            slos = SLO.parse_slos(doc["slos"], seen, error=_err)
         return cls(
             routes=tuple(specs),
             budget_mb=doc.get("budget_mb"),
             max_batch=doc.get("max_batch"),
             block_variants=doc.get("block_variants"),
+            slos=slos,
         )
 
     @classmethod

@@ -32,17 +32,55 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from spark_examples_tpu.core import live as live_view
+from spark_examples_tpu.core import telemetry
 from spark_examples_tpu.serve.server import (
     DeadlineExceeded,
     ProjectionServer,
     ServerClosed,
     ServerOverloaded,
 )
+
+_TRACE_ID_MAX = 64
+
+
+def _request_trace_id(handler) -> str:
+    """Accept the client's X-Trace-Id (sanitized: url-safe token chars,
+    bounded length) or mint a fresh one — either way the id is echoed
+    back, so client and server logs join on it without guessing."""
+    raw = (handler.headers.get("X-Trace-Id") or "").strip()
+    if (raw and len(raw) <= _TRACE_ID_MAX
+            and all(c.isalnum() or c in "-_." for c in raw)):
+        return raw
+    return telemetry.new_trace_id()
+
+
+def _server_timing(phases: dict) -> str:
+    """The per-request phase breakdown as a Server-Timing header value
+    (milliseconds, RFC 8941 shape: ``queue;dur=1.2, compute;dur=3.4``)."""
+    return ", ".join(f"{k};dur={v * 1e3:.3f}"
+                     for k, v in phases.items()
+                     if isinstance(v, (int, float)))
+
+
+def _reply_debug_requests(handler) -> None:
+    """GET /debug/requests: the slowest-K request exemplar ring keyed
+    by trace_id, plus the active sample rate."""
+    body = json.dumps({
+        "exemplars": telemetry.request_exemplars(),
+        "trace_sample": telemetry.trace_sample(),
+    }, default=str).encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.send_header("X-Run-Id", telemetry.run_id())
+    handler.end_headers()
+    handler.wfile.write(body)
 
 
 def _parse_project_body(handler) -> tuple[np.ndarray, float | None, dict]:
@@ -72,11 +110,17 @@ def _make_handler(pserver: ProjectionServer):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: dict | None = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            # Every answer names the serving run: client-side error
+            # records join server-side traces on this id.
+            self.send_header("X-Run-Id", telemetry.run_id())
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -106,6 +150,9 @@ def _make_handler(pserver: ProjectionServer):
             if self.path == "/debug/telemetry":
                 live_view.reply_debug_telemetry(self)
                 return
+            if self.path == "/debug/requests":
+                _reply_debug_requests(self)
+                return
             self._reply(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802 (stdlib API)
@@ -117,20 +164,39 @@ def _make_handler(pserver: ProjectionServer):
             except (ValueError, KeyError, TypeError, OverflowError) as e:
                 self._reply(400, {"error": f"bad request body: {e}"})
                 return
+            tid = _request_trace_id(self)
+            sampled = telemetry.should_sample(tid)
+            t0 = time.perf_counter()
+            code, payload = 200, None
             try:
-                coords = pserver.project(genotypes, deadline_s=deadline_s)
+                with telemetry.trace_scope(trace_id=tid):
+                    coords = pserver.project(genotypes,
+                                             deadline_s=deadline_s)
             except ServerOverloaded as e:
-                self._reply(429, {"error": str(e)})
+                code, payload = 429, {"error": str(e)}
             except DeadlineExceeded as e:
-                self._reply(504, {"error": str(e)})
+                code, payload = 504, {"error": str(e)}
             except ServerClosed as e:
-                self._reply(503, {"error": str(e)})
+                code, payload = 503, {"error": str(e)}
             except ValueError as e:
-                self._reply(400, {"error": str(e)})
+                code, payload = 400, {"error": str(e)}
             except Exception as e:  # answered, never a dropped socket
-                self._reply(500, {"error": repr(e)})
+                code, payload = 500, {"error": repr(e)}
             else:
-                self._reply(200, {"coords": coords.tolist()})
+                payload = {"coords": coords.tolist()}
+            total = time.perf_counter() - t0
+            phases = {"total": total}
+            if sampled:
+                telemetry.count("trace.sampled")
+                telemetry.span_at("trace.request", t0, total,
+                                  trace_id=tid, route="", cls="",
+                                  status=code)
+                telemetry.record_request_exemplar(
+                    tid, total, phases, route="", cls="", status=code)
+            self._reply(code, payload, headers={
+                "X-Trace-Id": tid,
+                "Server-Timing": _server_timing(phases),
+            })
 
     return Handler
 
@@ -149,11 +215,15 @@ def _make_fleet_handler(fleet):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: dict | None = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Run-Id", telemetry.run_id())
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -196,6 +266,9 @@ def _make_fleet_handler(fleet):
             if self.path == "/debug/telemetry":
                 live_view.reply_debug_telemetry(self)
                 return
+            if self.path == "/debug/requests":
+                _reply_debug_requests(self)
+                return
             self._reply(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802 (stdlib API)
@@ -218,27 +291,57 @@ def _make_fleet_handler(fleet):
             except (ValueError, KeyError, TypeError, OverflowError) as e:
                 self._reply(400, {"error": f"bad request body: {e}"})
                 return
+            tid = _request_trace_id(self)
+            sampled = telemetry.should_sample(tid)
+            # The router writes the per-phase breakdown (queue wait,
+            # cold-start stage share, compute share, cache hits) back
+            # into this dict before resolving the request's future —
+            # the Server-Timing header and the exemplar ring read it.
+            trace = {"trace_id": tid, "span_id": telemetry.new_span_id(),
+                     "sampled": sampled, "phases": {}}
+            t0 = time.perf_counter()
+            code = 200
             try:
-                coords = fleet.project(route, genotypes,
-                                       deadline_s=deadline_s, **kwargs)
+                with telemetry.trace_scope(trace_id=tid,
+                                           span_id=trace["span_id"]):
+                    coords = fleet.project(route, genotypes,
+                                           deadline_s=deadline_s,
+                                           trace=trace, **kwargs)
             except UnknownRoute as e:
-                self._reply(404, {"error": str(e)})
+                code, payload = 404, {"error": str(e)}
             except ServerOverloaded as e:
-                self._reply(429, {"error": str(e)})
+                code, payload = 429, {"error": str(e)}
             except DeadlineExceeded as e:
-                self._reply(504, {"error": str(e)})
+                code, payload = 504, {"error": str(e)}
             except ServerClosed as e:
-                self._reply(503, {"error": str(e)})
+                code, payload = 503, {"error": str(e)}
             except PanelUnavailable as e:
                 # The route's panel cannot stage right now (breaker
                 # open / store down) — unavailable, not a client error.
-                self._reply(503, {"error": str(e)})
+                code, payload = 503, {"error": str(e)}
             except ValueError as e:
-                self._reply(400, {"error": str(e)})
+                code, payload = 400, {"error": str(e)}
             except Exception as e:  # answered, never a dropped socket
-                self._reply(500, {"error": repr(e)})
+                code, payload = 500, {"error": repr(e)}
             else:
-                self._reply(200, {"coords": coords.tolist()})
+                payload = {"coords": coords.tolist()}
+            total = time.perf_counter() - t0
+            phases = {**trace["phases"], "total": total}
+            cls = kwargs.get("priority", "")
+            if sampled:
+                telemetry.count("trace.sampled")
+                telemetry.span_at(
+                    "trace.request", t0, total, trace_id=tid,
+                    span_id=trace["span_id"], route=route, cls=cls,
+                    status=code,
+                    cache_hit=bool(trace.get("cache_hit")))
+                telemetry.record_request_exemplar(
+                    tid, total, phases, route=route, cls=cls,
+                    status=code)
+            self._reply(code, payload, headers={
+                "X-Trace-Id": tid,
+                "Server-Timing": _server_timing(phases),
+            })
 
     return FleetHandler
 

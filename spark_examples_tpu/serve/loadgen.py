@@ -58,6 +58,31 @@ class _ClientTally:
     errors: int = 0
 
 
+# Client-side error records carry the server's run_id (and the request's
+# trace_id where one exists) so a client log line joins the server-side
+# trace export without guessing which run produced it.
+_ERROR_RECORDS_MAX = 50
+
+
+class _ErrorLog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.records: list[dict] = []
+        self.dropped = 0
+
+    def record(self, **fields) -> None:
+        rec = {"run_id": telemetry.run_id(), **fields}
+        with self._lock:
+            if len(self.records) < _ERROR_RECORDS_MAX:
+                self.records.append(rec)
+            else:
+                self.dropped += 1
+
+    def report(self) -> list[dict]:
+        with self._lock:
+            return list(self.records)
+
+
 def run_loadgen(server: ProjectionServer, pool: np.ndarray,
                 clients: int = 4, requests_per_client: int = 50,
                 deadline_s: float | None = None,
@@ -76,6 +101,7 @@ def run_loadgen(server: ProjectionServer, pool: np.ndarray,
     if pool.ndim != 2 or not len(pool):
         raise ValueError(f"query pool must be (Q, V) int8, got {pool.shape}")
     tallies = [_ClientTally() for _ in range(clients)]
+    errlog = _ErrorLog()
     start = threading.Barrier(clients + 1)
 
     def client(c: int) -> None:
@@ -92,8 +118,9 @@ def run_loadgen(server: ProjectionServer, pool: np.ndarray,
                 tally.shed += 1
             except DeadlineExceeded:
                 tally.deadline += 1
-            except Exception:
+            except Exception as e:
                 tally.errors += 1
+                errlog.record(client=c, error=repr(e))
 
     threads = [
         threading.Thread(target=client, args=(c,), daemon=True,
@@ -125,6 +152,7 @@ def run_loadgen(server: ProjectionServer, pool: np.ndarray,
         "latency_p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
         "latency_p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
         "latency_max_ms": round(lat.get("max", 0.0) * 1e3, 3),
+        "error_records": errlog.report(),
         "server": server.stats.snapshot(),
     }
 
@@ -160,6 +188,8 @@ def run_fleet_loadgen(fleet, pools: dict[str, np.ndarray],
         raise ValueError("empty mix — nothing to offer")
     start = threading.Barrier(len(tenants) + 1)
 
+    errlog = _ErrorLog()
+
     def client(idx: int) -> None:
         route, cls, tally, hist = tenants[idx]
         pool = pools[route]
@@ -178,8 +208,9 @@ def run_fleet_loadgen(fleet, pools: dict[str, np.ndarray],
                 tally.shed += 1
             except DeadlineExceeded:
                 tally.deadline += 1
-            except Exception:
+            except Exception as e:
                 tally.errors += 1
+                errlog.record(route=route, cls=cls, error=repr(e))
 
     threads = [
         threading.Thread(target=client, args=(i,), daemon=True,
@@ -235,6 +266,7 @@ def run_fleet_loadgen(fleet, pools: dict[str, np.ndarray],
         "completed": ok,
         "shed": sum(t.shed for _r, _c, t, _h in tenants),
         "errors": sum(t.errors for _r, _c, t, _h in tenants),
+        "error_records": errlog.report(),
         "per_class": per_class,
         "per_route": per_route,
     }
@@ -379,14 +411,24 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
         raise ValueError("hedging needs >= 2 replicas")
     pool = np.ascontiguousarray(pool, dtype=np.int8)
 
-    def _submit(replica, q):
+    def _submit(replica, q, trace=None):
         if route is None:
+            # Single-model ProjectionServer surface: no fleet trace
+            # plumbing (the fleet router owns phase write-back).
             return replica.submit(q, deadline_s=deadline_s)
         return replica.submit(route, q, priority=priority,
-                              deadline_s=deadline_s)
+                              deadline_s=deadline_s, trace=trace)
+
+    def _leg_trace(trace_id: str, sampled: bool, leg: str) -> dict:
+        # Both legs of one logical request share ONE trace_id (the
+        # waterfall key) with distinct span ids per leg.
+        return {"trace_id": trace_id,
+                "span_id": telemetry.new_span_id(),
+                "sampled": sampled, "leg": leg, "phases": {}}
 
     tallies = [_ClientTally() for _ in range(clients)]
     hists = [telemetry.Histogram() for _ in range(clients)]
+    errlog = _ErrorLog()
     hedges = [[0, 0] for _ in range(clients)]  # [launched, wins]
     failovers = [0] * clients
     delay = _HedgeDelay(hedge_floor_s, seed=seed)
@@ -400,6 +442,8 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
             q = pool[(c + k * clients) % len(pool)]
             tally.attempts += 1
             t0 = time.perf_counter()
+            tid = telemetry.new_trace_id()
+            sampled = telemetry.should_sample(tid)
 
             def _finish() -> None:
                 dt = time.perf_counter() - t0
@@ -413,20 +457,26 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
                 failovers[c] += 1
                 telemetry.count("fleet.failovers")
                 try:
-                    fut = _submit(backup_replica, q)
+                    fut = _submit(backup_replica, q,
+                                  _leg_trace(tid, sampled, "failover"))
                     fut.result(timeout=result_timeout_s)
-                except Exception:
+                except Exception as e:
                     tally.errors += 1
+                    errlog.record(client=c, trace_id=tid,
+                                  leg="failover", error=repr(e))
                     return
                 _finish()
 
             try:
-                primary = _submit(replicas[0], q)
+                primary = _submit(replicas[0], q,
+                                  _leg_trace(tid, sampled, "primary"))
             except ServerClosed:
                 _failover()
                 continue
-            except Exception:
+            except Exception as e:
                 tally.errors += 1
+                errlog.record(client=c, trace_id=tid, leg="primary",
+                              error=repr(e))
                 continue
             hedge_after = delay.delay_s()
             try:
@@ -439,19 +489,22 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
                 # the answer.
                 _failover()
                 continue
-            except Exception:
+            except Exception as e:
                 # done-with-exception = a real failure (shed, deadline,
                 # fault) — NOT a hedge trigger. Only an unanswered
                 # primary past the delay hedges (the wait timed out and
                 # the future is still pending/running).
                 if primary.done():
                     tally.errors += 1
+                    errlog.record(client=c, trace_id=tid,
+                                  leg="primary", error=repr(e))
                     continue
             # Primary is the straggler: hedge to the next replica.
             hedges[c][0] += 1
             telemetry.count("fleet.hedge_launched")
             try:
-                hedge = _submit(backup_replica, q)
+                hedge = _submit(backup_replica, q,
+                                _leg_trace(tid, sampled, "hedge"))
             except Exception:
                 hedge = None
             futs = [f for f in (primary, hedge) if f is not None]
@@ -469,6 +522,8 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
                 winner = hedge
             if winner is None:
                 tally.errors += 1
+                errlog.record(client=c, trace_id=tid, leg="hedged",
+                              error="no leg answered in time")
                 continue
             loser = primary if winner is hedge else hedge
             try:
@@ -487,14 +542,21 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
                     if loser is hedge:
                         hedges[c][1] += 1
                         telemetry.count("fleet.hedge_wins")
+                    telemetry.event(
+                        "trace.hedge", trace_id=tid,
+                        winner="hedge" if loser is hedge else "primary",
+                        loser="cancelled_by_replica_loss",
+                        salvaged=True)
                     _finish()
                 else:
                     _failover()
                 continue
-            except Exception:
+            except Exception as e:
                 if loser is not None:
                     loser.cancel()
                 tally.errors += 1
+                errlog.record(client=c, trace_id=tid, leg="winner",
+                              error=repr(e))
                 continue
             # Cancelled only AFTER the winner resolved: a queued loser
             # drops at batch pickup; one already running finishes and
@@ -505,6 +567,10 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
             if winner is hedge:
                 hedges[c][1] += 1
                 telemetry.count("fleet.hedge_wins")
+            telemetry.event(
+                "trace.hedge", trace_id=tid,
+                winner="hedge" if winner is hedge else "primary",
+                loser="cancelled" if loser is not None else "none")
             # The hedged request's end-to-end latency feeds the p95 too
             # — a systematically slow primary keeps the trigger honest.
             _finish()
@@ -533,6 +599,7 @@ def run_hedged_loadgen(replicas, pool: np.ndarray,
         "duration_s": round(duration, 4),
         "completed": ok,
         "errors": sum(t.errors for t in tallies),
+        "error_records": errlog.report(),
         "sustained_qps": round(ok / duration, 2),
         "failovers": sum(failovers),
         "hedge_launched": launched,

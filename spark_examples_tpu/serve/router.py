@@ -149,6 +149,10 @@ class _Pending:
     t_submit: float
     deadline: float | None
     finished: bool = False
+    # Request-scoped trace context (serve/http.py mints it): the worker
+    # writes phase timings into trace["phases"] BEFORE resolving the
+    # future, so the handler reads them with happens-before for free.
+    trace: dict | None = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -478,7 +482,8 @@ class FleetRouter:
 
     def submit(self, route_name: str, genotypes: np.ndarray,
                priority: str = DEFAULT_PRIORITY,
-               deadline_s: float | None = None) -> Future:
+               deadline_s: float | None = None,
+               trace: dict | None = None) -> Future:
         """Admit one single-sample query against ``route_name``;
         returns a Future resolving to its (1, k) coordinates. Raises
         :class:`UnknownRoute`, :class:`ServerOverloaded` (the class's
@@ -514,6 +519,10 @@ class FleetRouter:
                 route.bump("cache_hits")
                 route.bump("completed")
                 route.lat[priority].record(time.perf_counter() - t0)
+                if trace is not None:
+                    trace["cache_hit"] = True
+                    trace.setdefault("phases", {})["cache"] = \
+                        time.perf_counter() - t0
                 fut: Future = Future()
                 fut.set_result(np.array(hit))
                 return fut
@@ -528,6 +537,7 @@ class FleetRouter:
             digest=digest,
             t_submit=t0,
             deadline=(t0 + deadline_s) if deadline_s else None,
+            trace=trace,
         )
         with self._admission_lock:
             if self._closed:
@@ -547,10 +557,12 @@ class FleetRouter:
     def project(self, route_name: str, genotypes: np.ndarray,
                 timeout: float | None = None,
                 priority: str = DEFAULT_PRIORITY,
-                deadline_s: float | None = None) -> np.ndarray:
+                deadline_s: float | None = None,
+                trace: dict | None = None) -> np.ndarray:
         """Synchronous convenience: submit + wait."""
         return self.submit(route_name, genotypes, priority=priority,
-                           deadline_s=deadline_s).result(timeout=timeout)
+                           deadline_s=deadline_s,
+                           trace=trace).result(timeout=timeout)
 
     # -- introspection -----------------------------------------------------
 
@@ -673,6 +685,9 @@ class FleetRouter:
                 now = time.perf_counter()
                 telemetry.observe("serve.enqueue_wait_s",
                                   now - p.t_submit)
+                if p.trace is not None:
+                    p.trace.setdefault("phases", {})["queue"] = \
+                        now - p.t_submit
                 try:
                     faults.fire("serve.request")
                 except BaseException as e:
@@ -724,12 +739,18 @@ class FleetRouter:
             if not live:
                 return
             g = np.stack([p.genotypes for p in live])
+        t_device = time.perf_counter()
+        cold = not self.pool.is_staged(route.name)
+        stage_s = 0.0
         with telemetry.span("serve.device_step", cat="serve",
                             rows=len(live), route=route.name):
             try:
                 with self._engine_lock:
                     panel = self.pool.acquire(route.name, route.stage,
                                               breaker=route.breaker)
+                    t_compute = time.perf_counter()
+                    if cold:
+                        stage_s = t_compute - t_device
                     coords = E.batch_coords(
                         route.ctx, panel.blocks, g, self.max_batch,
                         panel.n_variants)
@@ -739,6 +760,7 @@ class FleetRouter:
                 for p in live:
                     self._fail(p, e)
                 return
+        compute_s = time.perf_counter() - t_compute
         telemetry.observe("serve.batch_rows", len(live))
         results = [(p, row[None, :]) for p, row in zip(live, coords)]
         if self._cache.capacity:
@@ -755,6 +777,27 @@ class FleetRouter:
                                             namespace=route.cache_ns)
         now = time.perf_counter()
         for p, result in results:
+            if p.trace is not None:
+                # Phase write-back BEFORE set_result: the HTTP handler
+                # reads trace["phases"] after .result() returns, so
+                # future resolution is the happens-before edge.
+                ph = p.trace.setdefault("phases", {})
+                if stage_s:
+                    ph["stage"] = stage_s
+                ph["compute"] = compute_s
+                p.trace["cold_start"] = cold
+                if p.trace.get("sampled"):
+                    ids = {"trace_id": p.trace.get("trace_id", ""),
+                           "span_id": p.trace.get("span_id", "")}
+                    telemetry.span_at(
+                        "trace.queue", p.t_submit,
+                        ph.get("queue", 0.0),
+                        route=p.route, cls=p.cls, **ids)
+                    telemetry.span_at(
+                        "trace.compute", t_device, now - t_device,
+                        route=p.route, cls=p.cls, rows=len(results),
+                        cold_start=cold,
+                        stage_s=round(stage_s, 6), **ids)
             p.future.set_result(result)
             dt = now - p.t_submit
             telemetry.observe("serve.latency_s", dt)

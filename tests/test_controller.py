@@ -618,8 +618,9 @@ def test_soak_registers_controller_scenarios_and_thread_prefix():
     assert "controller" in jobs
     sites = {site for j, site, *_ in SCENARIOS if j == "controller"}
     assert sites == {"controller.scrape", "controller.spawn",
-                     "fleet.stage"}
+                     "fleet.stage", "trace.export"}
     assert "fleet-controller" in _SUSPECT_THREADS
+    assert "fleet-metrics-http" in _SUSPECT_THREADS
 
 
 # --------------------------------------------- real replicas + readiness
@@ -964,3 +965,146 @@ def test_hedge_delay_seed_precharges_the_ring():
     for _ in range(256):
         seeded.record(0.5)
     assert seeded.delay_s() == pytest.approx(0.5)
+
+
+# ------------------------------- flight recorder rides the controller
+
+
+def _route_snap(p99=0.01, shed=0.0, route="a"):
+    return ReplicaSnapshot(
+        t=0.0, ready=True, health="healthy", worker_alive=True,
+        in_flight=0, queue_interactive=0, queue_batch=0, p99_s=p99,
+        shed_rate=shed, pool_bytes=0.0, pool_pressure=0.0,
+        routes={route: {"p99_s": p99, "queue_depth": 0,
+                        "shed_rate": shed, "staged": True}})
+
+
+def test_slo_breach_scales_up_within_the_same_round(tmp_path):
+    """ISSUE 17 acceptance: an injected latency regression trips the
+    fast-burn SLO AND the scale-up inside one control round — the
+    breach bypasses the (deliberately unreachable) pressure_rounds
+    gate."""
+    from spark_examples_tpu.fleet.slo import SLOSpec
+
+    ledger = str(tmp_path / "controller.json")
+    h = Harness(ledger=ledger, pressure_rounds=99,
+                slos=(SLOSpec(route="a", p99_ms=5.0,
+                              fast_window_s=30.0, slow_window_s=30.0),))
+    h.ctrl.start()
+    for r in h.made:
+        r.snap = _route_snap(p99=0.2)  # 40x over the objective
+    rounds_to_trip = 0
+    while len(h.ctrl.replicas()) < 3 and rounds_to_trip < 10:
+        h.tick()
+        rounds_to_trip += 1
+    led = h.ctrl.describe()
+    breach = next(i for i in led["incidents"]
+                  if i["kind"] == "slo_breach")
+    assert breach["who"] == "a" and "p99<=5" in breach["detail"]
+    scale = next(d for d in led["decisions"]
+                 if d["action"] == "scale_up")
+    assert scale["detail"].startswith("slo breach pressure (this round)")
+    # Same round: the breach incident and the scale-up decision carry
+    # the SAME round number — the controller did not wait a tick.
+    assert scale["round"] == breach["round"]
+    assert len(h.ctrl.replicas()) == 3
+    # The breach is visible on the metrics surface too.
+    gauges = telemetry.metrics_snapshot()["gauges"]
+    assert gauges["slo.a.breached"]["last"] == 1.0
+    h.ctrl.close()
+
+
+def test_healthy_fleet_never_trips_slo_pressure(tmp_path):
+    from spark_examples_tpu.fleet.slo import SLOSpec
+
+    h = Harness(pressure_rounds=99,
+                slos=(SLOSpec(route="a", p99_ms=500.0,
+                              fast_window_s=30.0, slow_window_s=30.0),))
+    h.ctrl.start()
+    for r in h.made:
+        r.snap = _route_snap(p99=0.01)
+    for _ in range(6):
+        h.tick()
+    assert len(h.ctrl.replicas()) == 2
+    assert not any(i["kind"] == "slo_breach"
+                   for i in h.ctrl.describe()["incidents"])
+    gauges = telemetry.metrics_snapshot()["gauges"]
+    assert gauges["slo.ok"]["last"] == 1.0
+    h.ctrl.close()
+
+
+def test_timeline_ring_lands_beside_the_ledger(tmp_path):
+    from spark_examples_tpu.fleet.timeline import read_timeline
+
+    ledger = str(tmp_path / "controller.json")
+    h = Harness(ledger=ledger)
+    h.ctrl.start()
+    for _ in range(3):
+        h.tick()
+    h.made[0].dead = True
+    h.tick()  # crash -> incident -> timeline marker
+    recs = read_timeline(str(tmp_path / "timeline.jsonl"))
+    rounds = [r for r in recs if r["type"] == "round"]
+    assert rounds and rounds[-1]["replicas"] >= 1
+    assert "replica-0" in rounds[1]["slots"]
+    assert any(r["type"] == "marker" and r["kind"] == "crash"
+               for r in recs)
+    h.ctrl.close()
+
+
+def test_ledger_rotates_full_generations_to_old(tmp_path):
+    ledger = str(tmp_path / "controller.json")
+    h = Harness(ledger=ledger)
+    h.ctrl.start()
+    for i in range(LEDGER_KEEP + 30):
+        h.ctrl._incident("replica-0", "probe", f"synthetic #{i}")
+    old = ledger + ".old"
+    assert os.path.exists(old)
+    with open(old) as f:
+        gen0 = json.load(f)  # atomic: parses mid-stream
+    # The archived generation holds the FULL deque from just before
+    # the first drop — nothing silently discarded.
+    assert len(gen0["incidents"]) == LEDGER_KEEP
+    assert gen0["incidents"][0]["detail"] == "synthetic #0"
+    assert telemetry.counter_value("controller.ledger_rotations") == 1
+    # One rotation covers the next LEDGER_KEEP drops: no re-rotation
+    # until another full generation has rolled through.
+    for i in range(LEDGER_KEEP - 30):
+        h.ctrl._incident("replica-0", "probe", f"late #{i}")
+    assert telemetry.counter_value("controller.ledger_rotations") == 1
+    h.ctrl._incident("replica-0", "probe", "tips the second generation")
+    assert telemetry.counter_value("controller.ledger_rotations") == 2
+    with open(old) as f:
+        gen1 = json.load(f)
+    assert gen1["incidents"][-1]["detail"] == "late #169"
+    h.ctrl.close()
+
+
+def test_controller_serves_the_fleet_metrics_surface(tmp_path):
+    import urllib.request
+
+    h = Harness(ledger=str(tmp_path / "controller.json"))
+    h.ctrl.start()
+    for r in h.made:
+        r.snap = _route_snap(p99=0.02)
+    for _ in range(2):
+        h.tick()
+    port_file = str(tmp_path / "metrics_port.json")
+    srv = h.ctrl.serve_metrics(port_file=port_file)
+    assert h.ctrl.serve_metrics() is srv  # idempotent
+    with open(port_file) as f:
+        port = int(f.read())
+    assert port == srv.port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet/metrics", timeout=30) as r:
+        prom = r.read().decode()
+    assert "timeline_fleet_p99_s" in prom
+    assert "timeline_route_a_p99_s" in prom
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet/timeline", timeout=30) as r:
+        doc = json.loads(r.read())
+    assert any(rec["type"] == "round" for rec in doc["records"])
+    h.ctrl.close()  # close() tears the metrics server down too
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet/metrics", timeout=5)

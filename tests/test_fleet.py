@@ -651,6 +651,115 @@ def test_fleet_http_front(fx):
         fleet.close()
 
 
+def test_http_front_trace_surfaces(fx, tmp_path):
+    """ISSUE 17: the fleet HTTP front echoes the client's X-Trace-Id
+    (or mints one), stamps X-Run-Id and a Server-Timing phase
+    breakdown on every response, and serves the slowest-K exemplar
+    ring at GET /debug/requests."""
+    from spark_examples_tpu.serve.http import start_fleet_http_server
+
+    telemetry.configure(dir=str(tmp_path / "tel"), trace_events=True)
+    sample0 = telemetry.trace_sample()
+    telemetry.set_trace_sample(1.0)
+    fleet = _build(fx).start()
+    http = start_fleet_http_server(fleet, port=0)
+    base = f"http://127.0.0.1:{http.port}"
+    rng = np.random.default_rng(47)
+    q = random_genotypes(rng, n=1, v=V, missing_rate=0.1)[0]
+    body = json.dumps({"genotypes": [int(x) for x in q]}).encode()
+    try:
+        req = urllib.request.Request(
+            f"{base}/project/r-ibs", data=body, method="POST")
+        req.add_header("X-Trace-Id", "client-chosen-trace-01")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["X-Trace-Id"] == "client-chosen-trace-01"
+            assert resp.headers["X-Run-Id"] == telemetry.run_id()
+            timing = resp.headers["Server-Timing"]
+        # The phase breakdown names at least the total and the compute
+        # leg (cache/queue appear when those phases happened).
+        assert "total;dur=" in timing
+        assert "compute;dur=" in timing
+        # No header -> the server mints a 16-hex id.
+        req2 = urllib.request.Request(
+            f"{base}/project/r-ibs", data=body, method="POST")
+        with urllib.request.urlopen(req2, timeout=60) as resp:
+            minted = resp.headers["X-Trace-Id"]
+        assert len(minted) == 16 and int(minted, 16) >= 0
+        with urllib.request.urlopen(f"{base}/debug/requests",
+                                    timeout=30) as r:
+            dbg = json.loads(r.read())
+        assert dbg["trace_sample"] == 1.0
+        by_tid = {e["trace_id"]: e for e in dbg["exemplars"]}
+        assert "client-chosen-trace-01" in by_tid
+        ex = by_tid["client-chosen-trace-01"]
+        assert ex["route"] == "r-ibs" and ex["status"] == 200
+        assert "total" in ex["phases"] and "compute" in ex["phases"]
+        # The sampled request also left a trace.request span behind.
+        assert telemetry.metrics_snapshot()[
+            "histograms"]["trace.request"]["count"] >= 2
+    finally:
+        telemetry.set_trace_sample(sample0)
+        http.shutdown()
+        fleet.close()
+
+
+def test_hedged_legs_share_one_trace_id(fx, tmp_path):
+    """Both legs of a hedged request carry ONE trace_id with distinct
+    span ids — the waterfall key that joins the client's trace.hedge
+    attribution event to the server-side queue/compute spans."""
+    telemetry.configure(dir=str(tmp_path / "tel"), trace_events=True)
+    sample0 = telemetry.trace_sample()
+    telemetry.set_trace_sample(1.0)
+    slow = _build(fx, cfg=ServeConfig(cache_entries=0,
+                                      max_linger_ms=120.0)).start()
+    fast = _build(fx, cfg=ServeConfig(cache_entries=0,
+                                      max_linger_ms=0.0)).start()
+    rng = np.random.default_rng(48)
+    pool = random_genotypes(rng, n=16, v=V, missing_rate=0.1)
+    try:
+        report = run_hedged_loadgen(
+            [slow, fast], pool, clients=2, requests_per_client=6,
+            route="r-ibs", hedge_floor_s=0.02)
+        assert report["errors"] == 0 and report["hedge_launched"] > 0
+        evs = telemetry.recent_events()
+        hedge_tids = {e["args"]["trace_id"] for e in evs
+                      if e["name"] == "trace.hedge"}
+        assert hedge_tids  # every attribution event carries the key
+        span_ids = {}  # trace_id -> span ids seen on server spans
+        for e in evs:
+            if e["name"] in ("trace.queue", "trace.compute"):
+                span_ids.setdefault(
+                    e["args"]["trace_id"], set()).add(
+                        e["args"]["span_id"])
+        # Client-side hedge events and server-side spans join on the
+        # same trace ids.
+        assert hedge_tids & set(span_ids)
+        # Two legs submitted under ONE trace id get distinct span ids
+        # on their server spans (driven directly, like _leg_trace).
+        tid = telemetry.new_trace_id()
+        legs = []
+        for _ in range(2):
+            tr = {"trace_id": tid, "span_id": telemetry.new_span_id(),
+                  "sampled": True, "phases": {}}
+            legs.append((tr, fast.submit(
+                "r-ibs", pool[0], priority=INTERACTIVE, trace=tr)))
+        for _tr, fut in legs:
+            fut.result(timeout=60.0)
+        spans = [e for e in telemetry.recent_events()
+                 if e["name"] == "trace.compute"
+                 and e["args"]["trace_id"] == tid]
+        assert {e["args"]["span_id"] for e in spans} == \
+            {tr["span_id"] for tr, _f in legs}
+        assert len({tr["span_id"] for tr, _f in legs}) == 2
+        # Satellite: client-side error records carry the run id (none
+        # fired here — the contract is on the recorder itself).
+        assert report["error_records"] == []
+    finally:
+        telemetry.set_trace_sample(sample0)
+        slow.close()
+        fast.close()
+
+
 # ------------------------------------------------------------------ CLI
 
 

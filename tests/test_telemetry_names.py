@@ -300,7 +300,31 @@ def test_core_names_present():
         "controller.flap_breaker_open",
         "serve.drain_abandoned",
         "fleet.failovers",
+        # fleet flight recorder: request traces, the timeline ring,
+        # SLO burn signals (ISSUE 17's instrumentation contract)
+        "trace.request",
+        "trace.queue",
+        "trace.compute",
+        "trace.hedge",
+        "trace.sampled",
+        "trace.export_errors",
+        "trace.exemplars",
+        "timeline.rounds",
+        "timeline.markers",
+        "timeline.compactions",
+        "timeline.write_errors",
+        "timeline.bytes",
+        "timeline.fleet_p99_s",
+        "timeline.fleet_queue_depth",
+        "timeline.fleet_shed_rate",
+        "timeline.route.*",
+        "slo.breaches",
+        "slo.ok",
+        "slo.*",
+        "controller.ledger_rotations",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution
     assert not telemetry.is_declared("phasegram")
+    assert telemetry.is_declared("timeline.route.r-ibs.p99_s")
+    assert telemetry.is_declared("slo.r-ibs.fast_burn")

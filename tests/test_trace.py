@@ -1,0 +1,590 @@
+"""Fleet flight recorder (ISSUE 17): request-scoped trace context and
+deterministic sampling, the slowest-K exemplar ring, the controller's
+timeline ring (size-bounded, fault-tolerant, compacting), declarative
+SLO parsing + burn-rate evaluation, `GET /fleet/metrics`, fleet-wide
+stitching (`telemetry stitch --fleet`), the `telemetry timeline` CLI
+verb, and the cross-process acceptance: two real ProcessReplica serve
+children answering hedged requests that share ONE trace id, the
+primary killed mid-burst, stitched onto one waterfall with the
+controller's incident markers.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, stitch, telemetry
+from spark_examples_tpu.core.config import TelemetryConfig
+from spark_examples_tpu.fleet.replica import ReplicaSnapshot
+from spark_examples_tpu.fleet.slo import SLOEvaluator, SLOSpec
+from spark_examples_tpu.fleet.timeline import (
+    FleetTimeline,
+    TimelineMetricsServer,
+    read_timeline,
+)
+from spark_examples_tpu.serve import FleetFormatError, FleetManifest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    sample0 = telemetry.trace_sample()
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure(dir=None)
+    telemetry.set_trace_sample(sample0)
+
+
+# ------------------------------------------------------ trace context
+
+
+def test_trace_ids_are_hex_tokens():
+    tid = telemetry.new_trace_id()
+    sid = telemetry.new_span_id()
+    assert len(tid) == 16 and int(tid, 16) >= 0
+    assert len(sid) == 8 and int(sid, 16) >= 0
+    assert telemetry.new_trace_id() != tid
+
+
+def test_sampling_is_deterministic_on_the_trace_id():
+    telemetry.set_trace_sample(1.0)
+    assert telemetry.should_sample("anything")
+    telemetry.set_trace_sample(0.0)
+    assert not telemetry.should_sample("anything")
+    telemetry.set_trace_sample(0.5)
+    ids = [telemetry.new_trace_id() for _ in range(400)]
+    first = [telemetry.should_sample(t) for t in ids]
+    # Deterministic: the same id always decides the same way — the
+    # property hedge legs and child processes rely on.
+    assert [telemetry.should_sample(t) for t in ids] == first
+    frac = sum(first) / len(first)
+    assert 0.3 < frac < 0.7
+
+
+def test_trace_sample_flag_validated():
+    with pytest.raises(ValueError, match="--trace-sample"):
+        TelemetryConfig(trace_sample=1.5)
+    with pytest.raises(ValueError, match="--trace-sample"):
+        TelemetryConfig(trace_sample=True)
+    assert TelemetryConfig(trace_sample=0.25).trace_sample == 0.25
+
+
+def test_trace_scope_stamps_ids_into_events(tmp_path):
+    telemetry.configure(dir=str(tmp_path / "tel"), trace_events=True)
+    with telemetry.trace_scope(trace_id="a" * 16, span_id="b" * 8):
+        telemetry.event("trace.hedge", winner="primary", loser="none")
+    evs = [e for e in telemetry.recent_events()
+           if e["name"] == "trace.hedge"]
+    assert evs and evs[-1]["args"]["trace_id"] == "a" * 16
+    assert evs[-1]["args"]["winner"] == "primary"
+
+
+def test_span_at_records_retroactive_interval(tmp_path):
+    telemetry.configure(dir=str(tmp_path / "tel"), trace_events=True)
+    t0 = time.perf_counter() - 0.05
+    telemetry.span_at("trace.queue", t0, 0.05, trace_id="t1",
+                      span_id="s1", route="r", cls="interactive")
+    ev = next(e for e in telemetry.recent_events()
+              if e["name"] == "trace.queue")
+    assert ev["ph"] == "X"
+    assert ev["dur"] == pytest.approx(0.05 * 1e6)
+    assert ev["args"]["trace_id"] == "t1"
+    # The histogram side: span_at feeds the same latency registry a
+    # live span would.
+    hists = telemetry.metrics_snapshot()["histograms"]
+    assert hists["trace.queue"]["count"] == 1
+
+
+def test_exemplar_ring_keeps_the_slowest_k():
+    for i in range(telemetry.TRACE_EXEMPLARS + 18):
+        telemetry.record_request_exemplar(
+            f"t{i:04d}", total_s=i / 1e3,
+            phases={"total": i / 1e3}, route="r", status=200)
+    ex = telemetry.request_exemplars()
+    assert len(ex) == telemetry.TRACE_EXEMPLARS
+    # Slowest first, and the fast tail was evicted.
+    assert ex[0]["trace_id"] == f"t{telemetry.TRACE_EXEMPLARS + 17:04d}"
+    assert min(e["total_s"] for e in ex) == pytest.approx(18 / 1e3)
+    assert all("phases" in e and "t_unix" in e for e in ex)
+
+
+# ---------------------------------------------------- timeline ring
+
+
+def _snap(p99=0.01, shed=0.0, qi=0, qb=0, route="r-a", staged=True,
+          stale=False, ready=True):
+    return ReplicaSnapshot(
+        t=time.monotonic(), ready=ready, health="healthy",
+        worker_alive=True, in_flight=0, queue_interactive=qi,
+        queue_batch=qb, p99_s=p99, shed_rate=shed, pool_bytes=0.0,
+        pool_pressure=0.0, stale=stale,
+        routes={route: {"p99_s": p99, "queue_depth": qi + qb,
+                        "shed_rate": shed, "staged": staged}})
+
+
+def test_timeline_roundtrip_markers_and_folds(tmp_path):
+    path = str(tmp_path / "timeline.jsonl")
+    tl = FleetTimeline(path=path)
+    for rd in range(4):
+        tl.record_round(rd, {"replica-0": _snap(p99=0.01 * (rd + 1)),
+                             "replica-1": None}, 1, 1)
+    tl.record_marker(3, "replica-0", "crash", "killed mid-burst")
+    recs = read_timeline(path)
+    assert [r["type"] for r in recs] == ["round"] * 4 + ["marker"]
+    assert recs[0]["slots"]["replica-1"] == {"present": False}
+    assert recs[2]["slots"]["replica-0"]["routes"]["r-a"]["p99_s"] == \
+        pytest.approx(0.03)
+    assert recs[-1]["kind"] == "crash"
+    # recent() interleaves rounds and markers on one seq clock.
+    assert [r["seq"] for r in tl.recent()] == [1, 2, 3, 4, 5]
+    # Folds: the fleet p99 is a real Histogram.merge quantile over the
+    # per-slot rounds, published as timeline.* gauges.
+    gauges = telemetry.metrics_snapshot()["gauges"]
+    assert gauges["timeline.fleet_p99_s"]["last"] > 0.0
+    assert gauges["timeline.route.r-a.p99_s"]["last"] > 0.0
+    assert tl.route_quantile("r-a", 0.99) >= 0.01
+    assert telemetry.counter_value("timeline.rounds") == 4
+    assert telemetry.counter_value("timeline.markers") == 1
+
+
+def test_timeline_merges_quantiles_across_slots():
+    tl = FleetTimeline(path=None)  # memory-only mode
+    for rd in range(20):
+        tl.record_round(rd, {
+            "replica-0": _snap(p99=0.010),
+            "replica-1": _snap(p99=0.100),
+        }, 2, 2)
+    # The fleet-wide p99 sees BOTH slots' samples — a max-of-medians
+    # would sit at 0.1 only by luck; the merge provably spans both.
+    q99 = tl.route_quantile("r-a", 0.99)
+    q10 = tl.route_quantile("r-a", 0.10)
+    assert q99 >= 0.09
+    assert q10 <= 0.02
+
+
+def test_timeline_compacts_past_the_size_bound(tmp_path):
+    path = str(tmp_path / "timeline.jsonl")
+    tl = FleetTimeline(path=path, max_bytes=4096, window=6)
+    for rd in range(60):
+        tl.record_round(rd, {"replica-0": _snap()}, 1, 1)
+    assert telemetry.counter_value("timeline.compactions") >= 1
+    assert os.path.getsize(path) <= 4096 + 2048  # bound + one window
+    recs = read_timeline(path)
+    # The survivor set is the in-memory window plus appends since the
+    # last rewrite: far fewer records than were ever appended, and the
+    # newest round is always the last line on the tape.
+    assert recs[-1]["round"] == 59
+    assert 6 <= len([r for r in recs if r["type"] == "round"]) < 30
+
+
+def test_timeline_absorbs_trace_export_io_errors(tmp_path):
+    path = str(tmp_path / "timeline.jsonl")
+    tl = FleetTimeline(path=path)
+    with faults.armed(["trace.export:io_error:after=1:max=2"]):
+        for rd in range(5):  # never raises into the control loop
+            tl.record_round(rd, {"replica-0": _snap()}, 1, 1)
+    assert telemetry.counter_value("timeline.write_errors") == 2
+    recs = read_timeline(path)
+    assert len(recs) == 3  # the two failed appends are the only holes
+    assert recs[-1]["round"] == 4
+
+
+def test_timeline_truncate_fault_leaves_last_good_tape(tmp_path):
+    path = str(tmp_path / "timeline.jsonl")
+    tl = FleetTimeline(path=path)
+    with faults.armed(["trace.export:truncate:keep=8:after=3:max=1"]):
+        for rd in range(8):
+            tl.record_round(rd, {"replica-0": _snap()}, 1, 1)
+    # The truncate tore the tape down to 8 bytes mid-append — the
+    # round being written is lost, every complete record appended
+    # afterwards survives, and the reader skips the torn fragment.
+    recs = read_timeline(path)
+    assert [r["round"] for r in recs] == [4, 5, 6, 7]
+
+
+def test_timeline_config_validation_names_the_knob():
+    with pytest.raises(ValueError, match="--timeline-max-bytes"):
+        FleetTimeline(max_bytes=10)
+    with pytest.raises(ValueError, match="--timeline-max-bytes"):
+        FleetTimeline(max_bytes=True)
+    from spark_examples_tpu.fleet import ControllerConfig
+    with pytest.raises(ValueError, match="--timeline-max-bytes"):
+        ControllerConfig(timeline_max_bytes=1)
+
+
+def test_fleet_metrics_server_serves_folds_and_timeline(tmp_path):
+    tl = FleetTimeline(path=None)
+    for rd in range(3):
+        tl.record_round(rd, {"replica-0": _snap(p99=0.02, qi=3)}, 1, 1)
+    tl.record_marker(2, "r-a", "slo_breach", "p99<=5ms burned")
+    srv = TimelineMetricsServer(tl).serve_in_thread()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/fleet/metrics",
+                                    timeout=30) as r:
+            prom = r.read().decode()
+        assert "timeline_fleet_p99_s" in prom
+        assert "timeline_fleet_queue_depth" in prom
+        assert "timeline_route_r_a_p99_s" in prom
+        with urllib.request.urlopen(f"{base}/fleet/timeline",
+                                    timeout=30) as r:
+            doc = json.loads(r.read())
+        assert len(doc["records"]) == 4
+        assert doc["records"][-1]["kind"] == "slo_breach"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/nope", timeout=30)
+        assert err.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------- SLOs
+
+
+def _manifest(slos):
+    return {"routes": [{"name": "r-a", "model": "m.npz",
+                        "source": "synthetic"}],
+            "slos": slos}
+
+
+def test_slo_manifest_validation_names_the_entry():
+    with pytest.raises(FleetFormatError, match="'slos' must be a list"):
+        FleetManifest.parse(_manifest({"route": "r-a"}))
+    with pytest.raises(FleetFormatError, match=r"slos\[0\] has unknown"):
+        FleetManifest.parse(_manifest([{"route": "r-a", "p99ms": 5}]))
+    with pytest.raises(FleetFormatError, match="names no declared route"):
+        FleetManifest.parse(_manifest([{"route": "r-b", "p99_ms": 5}]))
+    with pytest.raises(FleetFormatError, match="declares no objective"):
+        FleetManifest.parse(_manifest([{"route": "r-a"}]))
+    with pytest.raises(FleetFormatError, match=r"slos\[0\]\.p99_ms"):
+        FleetManifest.parse(_manifest([{"route": "r-a", "p99_ms": -1}]))
+    with pytest.raises(FleetFormatError,
+                       match=r"slos\[0\]\.availability"):
+        FleetManifest.parse(
+            _manifest([{"route": "r-a", "availability": 1.5}]))
+    with pytest.raises(FleetFormatError, match="slow_window_s"):
+        FleetManifest.parse(_manifest([
+            {"route": "r-a", "p99_ms": 5, "fast_window_s": 60,
+             "slow_window_s": 30}]))
+    m = FleetManifest.parse(_manifest([
+        {"route": "r-a", "p99_ms": 50, "budget": 0.2},
+        {"route": "*", "availability": 0.99},
+    ]))
+    assert m.slos[0].p99_ms == 50.0 and m.slos[0].budget == 0.2
+    assert m.slos[0].key == "r-a"
+    assert m.slos[1].key == "fleet"
+    assert FleetManifest.parse(_manifest(None) | {"slos": None}).slos == ()
+
+
+def test_slo_burn_needs_min_rounds_before_claiming():
+    tl = FleetTimeline(path=None)
+    spec = SLOSpec(route="r-a", p99_ms=5.0, fast_window_s=30.0,
+                   slow_window_s=30.0)
+    for rd in range(2):  # violating, but too thin a window
+        tl.record_round(rd, {"replica-0": _snap(p99=0.2)}, 1, 1)
+    assert SLOEvaluator((spec,), tl).evaluate() == []
+    gauges = telemetry.metrics_snapshot()["gauges"]
+    assert gauges["slo.r-a.fast_burn"]["last"] == 0.0
+    assert gauges["slo.ok"]["last"] == 1.0
+
+
+def test_slo_breach_when_both_windows_burn():
+    tl = FleetTimeline(path=None)
+    spec = SLOSpec(route="r-a", p99_ms=5.0, fast_window_s=30.0,
+                   slow_window_s=30.0)
+    for rd in range(6):  # p99 40x over the objective, every round
+        tl.record_round(rd, {"replica-0": _snap(p99=0.2)}, 1, 1)
+    breaches = SLOEvaluator((spec,), tl).evaluate()
+    assert len(breaches) == 1
+    b = breaches[0]
+    assert b["route"] == "r-a" and "p99<=5" in b["objective"]
+    assert b["fast_burn"] >= 1.0 and b["slow_burn"] >= 1.0
+    gauges = telemetry.metrics_snapshot()["gauges"]
+    assert gauges["slo.r-a.breached"]["last"] == 1.0
+    assert gauges["slo.ok"]["last"] == 0.0
+    assert telemetry.counter_value("slo.breaches") == 1
+
+
+def test_slo_availability_objective_reads_shed_rate():
+    tl = FleetTimeline(path=None)
+    spec = SLOSpec(route="*", availability=0.99, fast_window_s=30.0,
+                   slow_window_s=30.0)
+    for rd in range(4):
+        tl.record_round(rd, {"replica-0": _snap(shed=0.5)}, 1, 1)
+    breaches = SLOEvaluator((spec,), tl).evaluate()
+    assert breaches and breaches[0]["key"] == "fleet"
+    assert "availability>=0.99" in breaches[0]["objective"]
+    # Healthy rounds push the violating fraction back under budget.
+    tl2 = FleetTimeline(path=None)
+    for rd in range(40):
+        tl2.record_round(rd, {"replica-0": _snap(shed=0.0)}, 1, 1)
+    assert SLOEvaluator((spec,), tl2).evaluate() == []
+
+
+# ----------------------------------------------------- fleet stitch
+
+
+def _write_slot_export(base, slot, events, run_id="rid1", epoch=1000.0,
+                       live_ring=False):
+    d = os.path.join(base, slot, "rank0")
+    os.makedirs(d)
+    if not live_ring:
+        with open(os.path.join(d, "metrics.json"), "w") as f:
+            json.dump({"counters": {}, "meta": {
+                "rank": 0, "attempt": 0, "run_id": run_id,
+                "epoch_unix_s": epoch}}, f)
+    name = "live_trace.jsonl" if live_ring else "trace.jsonl"
+    with open(os.path.join(d, name), "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def test_stitch_fleet_merges_slots_with_incident_markers(tmp_path):
+    base = str(tmp_path / "fleet")
+    span = {"name": "trace.compute", "cat": "trace", "ph": "X",
+            "dur": 5e3, "tid": 1}
+    # The hedged waterfall: both slots carry spans for ONE trace id —
+    # slot 0 (killed mid-burst) left only its live ring.
+    _write_slot_export(base, "replica-0", [
+        {**span, "ts": 10.0, "args": {"trace_id": "tt1",
+                                      "span_id": "a1"}}],
+        live_ring=True)
+    _write_slot_export(base, "replica-1", [
+        {**span, "ts": 20.0, "args": {"trace_id": "tt1",
+                                      "span_id": "b1"}},
+        {**span, "ts": 30.0, "args": {"trace_id": "tt2",
+                                      "span_id": "b2"}}])
+    with open(os.path.join(base, "controller.json"), "w") as f:
+        json.dump({"incidents": [
+            {"round": 3, "who": "replica-0", "kind": "crash",
+             "detail": "killed mid-burst", "t_unix": 1000.5}]}, f)
+    report = stitch.stitch_fleet(base)
+    assert report["slots"] == ["replica-0", "replica-1"]
+    assert report["events"] == 3
+    assert report["incident_markers"] == 1
+    lines = [json.loads(line)
+             for line in open(report["output"]) if line.strip()]
+    legs = [e for e in lines
+            if e.get("args", {}).get("trace_id") == "tt1"]
+    # One trace id, two slots, two distinct pid tracks (slot stride).
+    assert len(legs) == 2
+    assert abs(legs[0]["pid"] - legs[1]["pid"]) >= 1_000_000
+    assert {e["args"]["span_id"] for e in legs} == {"a1", "b1"}
+    marker = next(e for e in lines if e["name"] == "incident: crash")
+    assert marker["ph"] == "i" and marker["s"] == "g"
+    assert marker["args"]["who"] == "replica-0"
+    names = {e["args"].get("name") for e in lines if e.get("ph") == "M"}
+    assert {"replica-0 attempt 0 rank 0", "replica-1 attempt 0 rank 0",
+            "controller"} <= names
+
+
+def test_stitch_fleet_rejects_a_non_fleet_dir(tmp_path):
+    with pytest.raises(stitch.StitchError, match="fleet workdir"):
+        stitch.stitch_fleet(str(tmp_path))
+
+
+def test_stitch_fleet_reads_rotated_ledger_generation(tmp_path):
+    base = str(tmp_path / "fleet")
+    _write_slot_export(base, "replica-0", [
+        {"name": "trace.request", "ph": "X", "ts": 1.0, "dur": 1.0,
+         "tid": 1, "args": {}}])
+    inc = {"round": 1, "who": "replica-0", "kind": "crash",
+           "detail": "old generation", "t_unix": 1000.1}
+    with open(os.path.join(base, "controller.json.old"), "w") as f:
+        json.dump({"incidents": [inc]}, f)
+    with open(os.path.join(base, "controller.json"), "w") as f:
+        # The current ledger still holds the overlap entry — the
+        # stitch must dedup it, not double-mark.
+        json.dump({"incidents": [inc, {
+            "round": 9, "who": "replica-0", "kind": "flap",
+            "detail": "new generation", "t_unix": 1001.0}]}, f)
+    report = stitch.stitch_fleet(base)
+    assert report["incident_markers"] == 2
+
+
+# ----------------------------------------------------------- CLI
+
+
+def test_telemetry_timeline_cli_renders_the_tape(tmp_path, capsys):
+    from spark_examples_tpu.cli.main import main
+
+    tl = FleetTimeline(path=str(tmp_path / "timeline.jsonl"))
+    for rd in range(3):
+        tl.record_round(rd, {"replica-0": _snap(p99=0.025, qi=2)}, 1, 1)
+    tl.record_marker(2, "r-a", "slo_breach", "p99<=5ms burned: fast 3x")
+    rc = main(["telemetry", "timeline", "--path", str(tmp_path)])
+    assert rc == 0
+    out, err = capsys.readouterr()
+    report = json.loads(out.strip().splitlines()[-1])
+    assert report["rounds"] == 3 and report["markers"] == 1
+    assert report["replicas_last"] == 1
+    assert report["routes"]["r-a"]["p99_last_ms"] == pytest.approx(25.0)
+    assert report["marker_kinds"] == ["slo_breach"]
+    assert "slo_breach" in err and "round" in err
+
+
+def test_telemetry_timeline_cli_empty_tape_fails_loudly(tmp_path,
+                                                        capsys):
+    from spark_examples_tpu.cli.main import main
+
+    rc = main(["telemetry", "timeline", "--path", str(tmp_path)])
+    assert rc == 1
+    assert "no readable records" in capsys.readouterr().err
+
+
+# --------------------------------------- cross-process acceptance
+
+
+V_E2E = 64
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def fleet_cmd(tmp_path_factory):
+    """A one-route fleet manifest (tiny fitted model + compacted
+    store) and the serve child argv that loads it."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+    from spark_examples_tpu.store.writer import compact
+    from tests.conftest import random_genotypes
+
+    base = tmp_path_factory.mktemp("trace_e2e")
+    rng = np.random.default_rng(7)
+    g = random_genotypes(rng, n=8, v=V_E2E, missing_rate=0.1)
+    store = str(base / "store")
+    compact(store, ArraySource(g), chunk_variants=32)
+    model = str(base / "model.npz")
+    pcoa_job(JobConfig(
+        ingest=IngestConfig(block_variants=32),
+        compute=ComputeConfig(metric="ibs", num_pc=3),
+        model_path=model,
+    ), source=ArraySource(g))
+    manifest = str(base / "fleet.json")
+    with open(manifest, "w") as f:
+        json.dump({"routes": [{
+            "name": "r-ibs", "model": model,
+            "source": f"store:{store}", "block_variants": 32}]}, f)
+    argv = [sys.executable, "-m", "spark_examples_tpu", "serve",
+            "--fleet", manifest, "--port", "0"]
+    return argv
+
+
+def _post(port, trace_id=None, timeout=60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/project/r-ibs",
+        data=json.dumps({"genotypes": [0] * V_E2E}).encode(),
+        method="POST")
+    if trace_id:
+        req.add_header("X-Trace-Id", trace_id)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.headers, json.loads(resp.read())
+
+
+def _wait_port(replica, budget_s=120.0):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        if not replica.alive():
+            raise AssertionError(
+                f"{replica.name} died during startup")
+        if replica.port() is not None:
+            return replica.port()
+        time.sleep(0.1)
+    raise AssertionError(f"{replica.name} never announced a port")
+
+
+def test_hedged_trace_survives_replica_kill_end_to_end(fleet_cmd,
+                                                       tmp_path):
+    """ISSUE 17 acceptance: two REAL serve child processes, hedged
+    requests sharing one trace id, the primary SIGKILLed mid-burst —
+    `stitch_fleet` joins the survivor's spans, the killed replica's
+    live-ring spans, and the controller ledger's crash marker onto ONE
+    waterfall, all under the parent's run_id (propagated through the
+    ProcessReplica environment)."""
+    from spark_examples_tpu.fleet.replica import ProcessReplica
+
+    base = str(tmp_path / "fleetdir")
+    os.makedirs(base)
+    reps = []
+    for slot in ("replica-0", "replica-1"):
+        slot_dir = os.path.join(base, slot)
+        os.makedirs(slot_dir)
+        argv = fleet_cmd + ["--telemetry-dir", slot_dir,
+                            "--telemetry-flush-s", "0.2"]
+        reps.append(ProcessReplica(
+            slot, argv, workdir=base, budget_bytes=10_000_000,
+            route_names=["r-ibs"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     PYTHONPATH=REPO + os.pathsep
+                     + os.environ.get("PYTHONPATH", ""))).start())
+    r0, r1 = reps
+    try:
+        # run_id + sample rate ride the environment into both children.
+        assert r0.env[telemetry.ENV_RUN_ID] == telemetry.run_id()
+        assert r1.env[telemetry.ENV_RUN_ID] == telemetry.run_id()
+        p0, p1 = _wait_port(r0), _wait_port(r1)
+
+        shared = "hedge-e2e-" + telemetry.new_trace_id()
+        # Primary leg: the client's X-Trace-Id is echoed back and the
+        # response carries the serving run id + phase breakdown.
+        headers, out = _post(p0, trace_id=shared, timeout=120.0)
+        assert headers["X-Trace-Id"] == shared
+        assert headers["X-Run-Id"] == telemetry.run_id()
+        assert "total;dur=" in headers["Server-Timing"]
+        assert len(out["coords"][0]) == 3
+        # A server-minted id for a traceless client is a hex token.
+        h2, _ = _post(p0, timeout=120.0)
+        assert int(h2["X-Trace-Id"], 16) >= 0
+        # Let the periodic flusher publish the live ring, then KILL
+        # the primary mid-"burst" — no exit-time export happens.
+        time.sleep(0.8)
+        r0.kill()
+        assert not r0.alive()
+        # The hedge leg re-sends the SAME trace id to the survivor.
+        h3, out3 = _post(p1, trace_id=shared, timeout=120.0)
+        assert h3["X-Trace-Id"] == shared
+        np.testing.assert_array_equal(
+            np.asarray(out3["coords"], np.float32),
+            np.asarray(out["coords"], np.float32))
+        # The survivor's exemplar ring serves the request forensics.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{p1}/debug/requests",
+                timeout=30) as r:
+            dbg = json.loads(r.read())
+        assert dbg["trace_sample"] == telemetry.trace_sample()
+        assert any(e["trace_id"] == shared for e in dbg["exemplars"])
+        # Graceful drain: the survivor's exit-time export lands.
+        assert r1.drain(60.0)
+    finally:
+        for r in reps:
+            r.kill()
+    with open(os.path.join(base, "controller.json"), "w") as f:
+        json.dump({"incidents": [
+            {"round": 1, "who": "replica-0", "kind": "crash",
+             "detail": "killed mid-hedged-burst",
+             "t_unix": time.time()}]}, f)
+    report = stitch.stitch_fleet(base)
+    assert set(report["slots"]) == {"replica-0", "replica-1"}
+    assert report["incident_markers"] == 1
+    # ONE logical run across both processes (env-propagated run_id);
+    # the killed slot contributes via its live ring (no trace.jsonl).
+    assert not report["mixed_run_ids"]
+    assert not os.path.exists(
+        os.path.join(base, "replica-0", "rank0", "trace.jsonl"))
+    lines = [json.loads(line)
+             for line in open(report["output"]) if line.strip()]
+    legs = [e for e in lines
+            if e.get("args", {}).get("trace_id") == shared]
+    pids = {e["pid"] for e in legs}
+    assert len(legs) >= 2 and len(pids) == 2  # both process tracks
+    assert next(e for e in lines
+                if e["name"] == "incident: crash")["args"]["who"] == \
+        "replica-0"

@@ -87,6 +87,11 @@ def test_metric_directions_resolve_sensibly():
     assert d("controller_p99_loss_s") == trend.LOWER_IS_BETTER
     assert d("controller_ok") == trend.BOOL_MUST_HOLD
     assert d("controller_replicas") is None
+    # Flight recorder (bench --fleet): the tracing tax must trend
+    # DOWN (and stay under the ~2% budget); the synthetic fast-burn
+    # SLO trip is a must-hold boolean via the *_ok suffix.
+    assert d("trace_overhead_frac") == trend.LOWER_IS_BETTER
+    assert d("slo_fast_burn_ok") == trend.BOOL_MUST_HOLD
 
 
 # ------------------------------------------------------------------ the band

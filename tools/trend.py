@@ -99,6 +99,11 @@ _EXPLICIT: dict[str, int | None] = {
     # controller_ok through the *_ok must-hold gate.
     "controller_burst_shed_rate": LOWER_IS_BETTER,
     "controller_replicas": None,
+    # Tracing tax (bench --fleet): traced-vs-untraced loadgen wall
+    # overhead as a fraction — "_frac" has no suffix rule, and this
+    # one must go DOWN (the flight recorder budget: <= 2% is the PR
+    # gate). slo_fast_burn_ok rides the *_ok must-hold gate.
+    "trace_overhead_frac": LOWER_IS_BETTER,
 }
 
 # (match kind, token, direction) — first hit wins, checked in order:
