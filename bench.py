@@ -41,6 +41,15 @@ a hedged-vs-unhedged tail comparison on a delay-injected replica
 (headline ``fleet_routes`` / ``fleet_p99_interactive_s`` /
 ``fleet_hedge_win_frac`` / ``fleet_evictions`` / ``fleet_ok``).
 
+``--neighbors`` benches the MinHash/LSH neighbor engine
+(spark_examples_tpu/neighbors/): the sparse top-k path vs the dense
+exact route on a planted-relatives cohort, plus the served ``POST
+/neighbors`` p99 under closed-loop load (headline
+``neighbors_filter_frac`` / ``neighbors_recall_at_k`` /
+``neighbors_sparse_speedup_vs_dense`` / ``neighbors_p99_ms`` /
+``neighbors_ok`` — the acceptance contract is <= 10% of pairs
+evaluated at recall >= 0.95, served bit-identical to offline).
+
 ``--multichip`` measures the REAL sharded tile2d path (not a dryrun) on
 whatever mesh exists — all local chips, or an 8-virtual-device CPU mesh
 self-provisioned in a subprocess when this session has one device:
@@ -1459,6 +1468,210 @@ def bench_fleet() -> dict:
     }
 
 
+NEIGHBORS_SAMPLES = 1024      # 64 founder families x 16 members — the
+NEIGHBORS_VARIANTS = 4096     # largest CPU-feasible planted cohort
+NEIGHBORS_K = 10              # the acceptance contract's k
+
+
+def _neighbors_cohort() -> np.ndarray:
+    """Planted-relatives cohort at bench scale: founder carrier sets
+    cloned with a few percent of entries resampled — every sample's
+    true nearest neighbors are its family, the structure the LSH
+    filter must recover (the scaled twin of the recall oracle in
+    tests/test_neighbors.py)."""
+    rng = np.random.default_rng(4242)
+    v, blocks = NEIGHBORS_VARIANTS, []
+    for _ in range(NEIGHBORS_SAMPLES // 16):
+        founder = (rng.random(v) < 0.08).astype(np.int8) * (
+            1 + (rng.random(v) < 0.3).astype(np.int8))
+        for _ in range(16):
+            g = founder.copy()
+            mut = rng.random(v) < 0.03
+            g[mut] = (rng.random(mut.sum()) < 0.08) * (
+                1 + (rng.random(mut.sum()) < 0.3)).astype(np.int8)
+            blocks.append(g)
+    return np.asarray(blocks, np.int8)
+
+
+def bench_neighbors() -> dict:
+    """``--neighbors``: the MinHash/LSH neighbor engine's headline.
+
+    The sparse path (streamed MinHash signatures -> LSH banding ->
+    exact evaluation of candidate pairs only -> sparse top-10 rows) vs
+    the dense exact route (full similarity matrix -> topk_rows) on a
+    planted-relatives cohort. Reported: the fraction of all N(N-1)/2
+    pairs the filter avoided, recall@10 vs the dense exact top-k, the
+    end-to-end sparse-vs-dense wall ratio, and the served ``POST
+    /neighbors`` p99 under closed-loop load with a bit-identity probe
+    vs the offline engine — the acceptance contract is <= 10% of pairs
+    evaluated at recall >= 0.95, served == offline bytes."""
+    import tempfile
+    import urllib.request
+
+    from spark_examples_tpu.core import telemetry
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig, ServeConfig,
+    )
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.neighbors.engine import neighbors_job, topk_rows
+    from spark_examples_tpu.pipelines.jobs import (
+        pcoa_job, similarity_matrix_job,
+    )
+    from spark_examples_tpu.serve import engine as serve_engine
+    from spark_examples_tpu.serve.fleet import FleetManifest, build_fleet
+    from spark_examples_tpu.store.writer import compact
+
+    g = _neighbors_cohort()
+    n, nv, k = len(g), g.shape[1], NEIGHBORS_K
+    base = JobConfig(
+        ingest=IngestConfig(block_variants=1024),
+        compute=ComputeConfig(metric=METRIC),
+    )
+
+    # Dense exact route: the full N x N matrix, then the same top-k
+    # row reduction the sparse path uses — wall time AND ground truth.
+    t0 = time.perf_counter()
+    dense = similarity_matrix_job(base, source=ArraySource(g)).similarity
+    dense = np.asarray(dense, np.float64).copy()
+    np.fill_diagonal(dense, -np.inf)
+    dense_ids, _ = topk_rows(dense, k)
+    dense_s = time.perf_counter() - t0
+
+    # Sparse route, end-to-end: signatures + banding + exact candidate
+    # evaluation + sparse reduction. Counter deltas, not absolutes —
+    # the bench process registry is shared.
+    cand0 = telemetry.counter_value("neighbors.candidate_pairs")
+    job = base.replace(compute=ComputeConfig(
+        metric=METRIC, minhash_hashes=64, minhash_bands=16,
+        neighbors_k=k))
+    t0 = time.perf_counter()
+    res = neighbors_job(job, source=ArraySource(g))
+    sparse_s = time.perf_counter() - t0
+    candidates = telemetry.counter_value("neighbors.candidate_pairs") - cand0
+    all_pairs = n * (n - 1) / 2
+    frac_evaluated = candidates / all_pairs
+    hits = sum(
+        len(set(res.ids[i][res.ids[i] >= 0].tolist())
+            & set(dense_ids[i].tolist()))
+        for i in range(n)
+    )
+    recall = hits / float(n * k)
+
+    # Served /neighbors under closed-loop load: a store-backed topk
+    # route, every request a distinct never-cached query (cache off) so
+    # the p99 measures the padded-batch kernel path, plus a
+    # bit-identity probe vs the offline query-vs-panel engine.
+    os.makedirs(CACHE, exist_ok=True)
+    workdir = tempfile.mkdtemp(prefix="bench_neighbors_", dir=CACHE)
+    panel = g[:256]
+    store_dir = os.path.join(workdir, "store")
+    compact(store_dir, ArraySource(panel), chunk_variants=1024)
+    model = os.path.join(workdir, "model.npz")
+    pcoa_job(base.replace(model_path=model), source=ArraySource(panel))
+    manifest = FleetManifest.parse({
+        "budget_mb": 64.0,
+        "routes": [{"name": "nb", "model": model,
+                    "source": f"store:{store_dir}", "topk": True}],
+    })
+    fleet = build_fleet(
+        manifest, ServeConfig(cache_entries=0, max_linger_ms=1.0),
+        ingest_defaults=IngestConfig(block_variants=1024))
+    fleet.start()
+    http = None
+    try:
+        from spark_examples_tpu.serve.http import start_fleet_http_server
+
+        http = start_fleet_http_server(fleet)
+        qrng = np.random.default_rng(7)
+        n_clients, per_client = 4, 24
+        queries = np.where(
+            qrng.random((n_clients * per_client, nv)) < 0.02, -1,
+            qrng.integers(0, 3, (n_clients * per_client, nv)),
+        ).astype(np.int8)
+        probe = queries[0]
+        doc = json.loads(urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/neighbors/nb",
+            data=json.dumps(
+                {"genotypes": probe.tolist(), "k": k}).encode(),
+            headers={"Content-Type": "application/json"})).read())
+        from spark_examples_tpu.pipelines.project import load_model
+
+        ctx = serve_engine.ModelContext(load_model(model))
+        blocks, nvar, _ = serve_engine.stage_blocks(
+            ArraySource(panel), 1024)
+        off_ids, off_sims = serve_engine.batch_topk(
+            ctx, blocks, probe[None, :], 8, nvar, k)
+        identical = bool(
+            doc["neighbor_indices"] == [off_ids[0].tolist()]
+            and doc["similarities"] == [off_sims[0].tolist()])
+
+        lat_ms: list[float] = []
+        lat_lock = threading.Lock()
+        errors = [0]
+
+        def client(rows: np.ndarray) -> None:
+            for q in rows:
+                body = json.dumps(
+                    {"genotypes": q.tolist(), "k": k}).encode()
+                t = time.perf_counter()
+                try:
+                    urllib.request.urlopen(urllib.request.Request(
+                        f"http://127.0.0.1:{http.port}/neighbors/nb",
+                        data=body,
+                        headers={"Content-Type": "application/json"}),
+                        timeout=120).read()
+                except Exception:
+                    errors[0] += 1
+                    continue
+                with lat_lock:
+                    lat_ms.append((time.perf_counter() - t) * 1e3)
+
+        threads = [
+            threading.Thread(
+                target=client,
+                args=(queries[i * per_client:(i + 1) * per_client],),
+                daemon=True, name=f"loadgen-client-{i}")
+            for i in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        load_wall = time.perf_counter() - t0
+        p99_ms = float(np.percentile(lat_ms, 99)) if lat_ms else float("inf")
+        qps = round(len(lat_ms) / load_wall, 1)
+    finally:
+        if http is not None:
+            http.shutdown()
+        fleet.close()
+
+    ok = bool(recall >= 0.95 and frac_evaluated <= 0.10
+              and identical and errors[0] == 0)
+    log(f"neighbors: {n}x{nv} cohort, filter avoided "
+        f"{(1 - frac_evaluated) * 100:.1f}% of pairs "
+        f"({int(candidates)} candidates), recall@{k} {recall:.3f}, "
+        f"sparse {sparse_s:.2f}s vs dense {dense_s:.2f}s "
+        f"({dense_s / sparse_s:.2f}x), served p99 {p99_ms:.1f} ms "
+        f"({qps} QPS, {errors[0]} errors), bit-identical={identical}")
+    return {
+        "cohort": [n, nv],
+        "k": k,
+        "candidate_pairs": int(candidates),
+        "frac_evaluated": round(frac_evaluated, 4),
+        "filter_frac": round(1.0 - frac_evaluated, 4),
+        "recall_at_k": round(recall, 4),
+        "dense_s": round(dense_s, 3),
+        "sparse_s": round(sparse_s, 3),
+        "sparse_speedup_vs_dense": round(dense_s / sparse_s, 3),
+        "served_p99_ms": round(p99_ms, 2),
+        "served_qps": qps,
+        "served_errors": errors[0],
+        "bit_identical_vs_offline": identical,
+        "ok": ok,
+    }
+
+
 def bench_controller() -> dict:
     """``--controller``: the fleet control plane closing the autoscale
     loop (README 'Fleet control plane'). One compacted store, two
@@ -2123,6 +2336,37 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    if "--neighbors-only" in sys.argv:
+        # The standalone neighbor-engine row (CI / dev boxes that do
+        # not need the full config sweep): measure, record
+        # backend-tagged, exit nonzero unless the acceptance gate
+        # holds — same stdout contract as --multichip-only.
+        nb = bench_neighbors()
+        headline = {
+            "neighbors_filter_frac": nb["filter_frac"],
+            "neighbors_recall_at_k": nb["recall_at_k"],
+            "neighbors_sparse_speedup_vs_dense": nb[
+                "sparse_speedup_vs_dense"],
+            "neighbors_p99_ms": nb["served_p99_ms"],
+            "neighbors_ok": nb["ok"],
+        }
+        from tools import trend as trend_mod
+
+        history_path = os.path.join(REPO, trend_mod.HISTORY_FILE)
+        try:
+            trend_mod.append_history(history_path, headline, run_meta={
+                "argv": sys.argv[1:],
+                "backend": jax.default_backend(),
+                "device": str(jax.devices()[0].device_kind),
+            })
+        except OSError as e:
+            log(f"{trend_mod.HISTORY_FILE} not appended ({e})")
+        print(json.dumps({**headline, "configs": {"neighbors": nb}}))
+        print(json.dumps(headline))
+        if not headline["neighbors_ok"]:
+            raise SystemExit(1)
+        return
+
     telemetry_dir = _argv_value("--telemetry-dir")
     if telemetry_dir:
         telemetry.configure(dir=telemetry_dir, trace_events=True)
@@ -2248,6 +2492,13 @@ def main() -> None:
             log(f"controller FAILED: {e!r}")
             configs["controller"] = {"error": repr(e)}
 
+    if "--neighbors" in sys.argv:
+        try:
+            configs["neighbors"] = bench_neighbors()
+        except Exception as e:
+            log(f"neighbors FAILED: {e!r}")
+            configs["neighbors"] = {"error": repr(e)}
+
     if "--store" in sys.argv:
         try:
             configs["store"] = bench_store(store)
@@ -2368,6 +2619,14 @@ def main() -> None:
             and fl["hedge_hedged_p99_s"] < fl["hedge_unhedged_p99_s"]
             and fl["hedge_errors"] == 0
         )
+    if "neighbors" in configs and "error" not in configs["neighbors"]:
+        nb = configs["neighbors"]
+        headline["neighbors_filter_frac"] = nb["filter_frac"]
+        headline["neighbors_recall_at_k"] = nb["recall_at_k"]
+        headline["neighbors_sparse_speedup_vs_dense"] = nb[
+            "sparse_speedup_vs_dense"]
+        headline["neighbors_p99_ms"] = nb["served_p99_ms"]
+        headline["neighbors_ok"] = nb["ok"]
     if "controller" in configs and "error" not in configs["controller"]:
         ct = configs["controller"]
         headline["controller_scale_up_s"] = ct["scale_up_s"]

@@ -361,6 +361,19 @@ def _job_from_args(args) -> JobConfig:
             grm_precise=args.grm_precise,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every_blocks=args.checkpoint_every_blocks,
+            neighbors_output=getattr(
+                args, "neighbors_output",
+                ComputeConfig.neighbors_output),
+            neighbors_k=getattr(args, "neighbors_k",
+                                ComputeConfig.neighbors_k),
+            minhash_hashes=getattr(args, "minhash_hashes",
+                                   ComputeConfig.minhash_hashes),
+            minhash_bands=getattr(args, "minhash_bands",
+                                  ComputeConfig.minhash_bands),
+            minhash_seed=getattr(args, "minhash_seed",
+                                 ComputeConfig.minhash_seed),
+            minhash_bucket_cap=getattr(args, "minhash_bucket_cap",
+                                       ComputeConfig.minhash_bucket_cap),
         ),
         output_path=args.output_path,
         model_path=getattr(args, "save_model", None),
@@ -433,6 +446,64 @@ def main(argv: list[str] | None = None) -> int:
                         "model was fitted on); store:<dir> works here "
                         "too")
     p_proj.add_argument("--ref-path", default=None)
+
+    p_nb = sub.add_parser(
+        "neighbors",
+        help="sparse top-k nearest neighbors. Default COHORT mode: "
+        "MinHash signatures folded into one streamed variant pass, LSH "
+        "banding proposes candidate pairs, ONLY those pairs are "
+        "evaluated exactly through the metric's pairwise finalize, and "
+        "the per-sample top-k (or the raw candidate edge list with "
+        "--neighbors-output pairs) is written as a self-describing "
+        "binary to --output-path. With --model: QUERY-VS-PANEL mode — "
+        "rank each new sample's k nearest panel members by exact "
+        "similarity, bit-identical to a fleet route's POST /neighbors "
+        "(see README 'Top-k neighbors')",
+    )
+    _add_common(p_nb)
+    # Choices come from the config enum tuple (the __post_init__
+    # validator's source of truth), so argparse and config-time
+    # validation can never drift (graftlint: registry-literal).
+    p_nb.add_argument("--neighbors-output",
+                      default=ComputeConfig.neighbors_output,
+                      choices=list(config.NEIGHBORS_OUTPUTS),
+                      help="'topk' = per-sample k best neighbors "
+                      "(sparse, the default); 'pairs' = the evaluated "
+                      "candidate edge list with exact similarities")
+    p_nb.add_argument("--neighbors-k", type=int,
+                      default=ComputeConfig.neighbors_k,
+                      help="neighbors kept per sample (topk output)")
+    p_nb.add_argument("--minhash-hashes", type=int,
+                      default=ComputeConfig.minhash_hashes,
+                      help="MinHash signature length (k seeded "
+                      "permutations; must be a multiple of "
+                      "--minhash-bands)")
+    p_nb.add_argument("--minhash-bands", type=int,
+                      default=ComputeConfig.minhash_bands,
+                      help="LSH bands: more bands (fewer rows each) = "
+                      "more candidates/higher recall; fewer bands = "
+                      "stronger filtering")
+    p_nb.add_argument("--minhash-seed", type=int,
+                      default=ComputeConfig.minhash_seed,
+                      help="permutation seed — a resumed/supervised "
+                      "job must keep it (the checkpoint records it "
+                      "and rejects a mismatch)")
+    p_nb.add_argument("--minhash-bucket-cap", type=int,
+                      default=ComputeConfig.minhash_bucket_cap,
+                      help="max samples per band bucket; an over-cap "
+                      "bucket keeps its first members and counts the "
+                      "rest in neighbors.bucket_overflows (degenerate-"
+                      "bucket quadratic blowup guard)")
+    p_nb.add_argument("--model", default=None,
+                      help=".npz from pcoa --save-model: switch to "
+                      "query-vs-panel mode (--source/--path = the NEW "
+                      "queries, --ref-source/--ref-path = the panel)")
+    p_nb.add_argument("--ref-source", default="packed",
+                      type=_source_arg,
+                      metavar="{" + ",".join(_SOURCES) + "}",
+                      help="reference panel genotypes (query-vs-panel "
+                      "mode); store:<dir> works here too")
+    p_nb.add_argument("--ref-path", default=None)
 
     p_srv = sub.add_parser(
         "serve",
@@ -996,6 +1067,8 @@ def _dispatch(args, parser, job, J, build_source) -> int:
         )
         _print_coords(out, job)
         timer = out.timer
+    elif args.command == "neighbors":
+        return _run_neighbors(args, parser, job, build_source)
     elif args.command == "serve":
         return _run_serve(args, parser, job, build_source)
     elif args.command == "pack":
@@ -1055,6 +1128,116 @@ def _dispatch(args, parser, job, J, build_source) -> int:
 
     if args.timings:
         print(json.dumps(timer.report(), sort_keys=True), file=sys.stderr)
+    return 0
+
+
+def _run_neighbors(args, parser, job, build_source) -> int:
+    """The `neighbors` subcommand. Cohort mode runs the full
+    MinHash/LSH/exact-eval pipeline (neighbors/engine.py); query mode
+    (--model) funnels through the SAME serve-engine pairwise batch and
+    top-k reduction a fleet ``topk`` route uses, so the file written
+    here is bit-identical to the served /neighbors answers."""
+    import dataclasses as _dc
+
+    from spark_examples_tpu.core.profiling import PhaseTimer
+    from spark_examples_tpu.neighbors import TopKResult, save_result
+    from spark_examples_tpu.neighbors.engine import neighbors_job
+
+    timer = PhaseTimer()
+    if args.model:
+        from spark_examples_tpu.pipelines import project as P
+        from spark_examples_tpu.serve import engine as E
+
+        if args.maf > 0.0 or args.max_missing < 1.0 or args.ld_prune_r2 > 0:
+            parser.error(
+                "--maf/--max-missing/--ld-prune-r2 cannot apply during "
+                "query-vs-panel neighbors (data-dependent masks would "
+                "keep different variant subsets per cohort); filter "
+                "both cohorts to the same sites beforehand"
+            )
+        if _needs_ref_path(args):
+            parser.error("neighbors --model requires --ref-path (the "
+                         "panel genotypes the model was fitted on)")
+        try:
+            ctx = E.ModelContext(P.load_model(args.model))
+            E.check_topkable(ctx.model)
+        except ValueError as e:
+            parser.error(str(e))
+        ref_cfg = _dc.replace(job.ingest, source=args.ref_source,
+                              path=args.ref_path)
+        src_ref = build_source(ref_cfg)
+        P.check_reference_panel(ctx.model, src_ref)
+        with timer.phase("stage"):
+            blocks, n_variants, _nbytes = E.stage_blocks(
+                src_ref, job.ingest.block_variants)
+        q_cfg = job.ingest
+        if q_cfg.source == "synthetic":
+            q_cfg = _dc.replace(q_cfg, n_variants=n_variants)
+        q_src = build_source(q_cfg)
+        queries = np.concatenate(
+            [b for b, _ in q_src.blocks(q_cfg.block_variants)], axis=1)
+        if queries.shape[1] != n_variants:
+            parser.error(
+                f"query cohort carries {queries.shape[1]} variants but "
+                f"the model's panel has {n_variants} — both cohorts "
+                "must be genotyped at the panel's sites"
+            )
+        k = args.neighbors_k
+        # Chunked through the padded-batch serving kernel: hom-ref
+        # padding keeps every row's integer sums independent of the
+        # chunk size, so any chunking matches the server bit for bit.
+        batch = 8
+        ids_rows, sim_rows = [], []
+        with timer.phase("neighbors_eval"):
+            for i in range(0, queries.shape[0], batch):
+                ids, sims = E.batch_topk(
+                    ctx, blocks, queries[i:i + batch], batch,
+                    n_variants, k)
+                ids_rows.append(ids)
+                sim_rows.append(sims)
+        res = TopKResult(
+            ids=np.concatenate(ids_rows, axis=0),
+            sims=np.concatenate(sim_rows, axis=0),
+            sample_ids=tuple(q_src.sample_ids),
+            metric=ctx.model.metric,
+            k=int(ids_rows[0].shape[1]), n_variants=n_variants,
+        )
+        panel_ids = list(ctx.model.sample_ids)
+    else:
+        res = neighbors_job(job, timer=timer)
+        panel_ids = list(res.sample_ids)
+
+    if job.output_path:
+        with timer.phase("write"):
+            save_result(job.output_path, res)
+    suffix = f" -> {job.output_path}" if job.output_path else ""
+    if res.kind == "topk":
+        print(
+            f"neighbors[{res.metric}] top-{res.k} for "
+            f"{len(res.sample_ids)} samples over {res.n_variants} "
+            f"variants{suffix}"
+        )
+        for sid, ids, sims in list(zip(res.sample_ids, res.ids,
+                                       res.sims))[:5]:
+            cells = [
+                f"{panel_ids[j]}={s:.4f}"
+                for j, s in zip(ids.tolist(), sims.tolist()) if j >= 0
+            ]
+            print(sid + "\t" + "\t".join(cells[:5]))
+    else:
+        print(
+            f"neighbors[{res.metric}] {len(res.pairs)} evaluated "
+            f"candidate pairs among {len(res.sample_ids)} samples "
+            f"over {res.n_variants} variants{suffix}"
+        )
+        order = np.argsort(-res.sims, kind="stable")[:5]
+        for t in order:
+            i, j = res.pairs[t]
+            print(f"{res.sample_ids[i]}\t{res.sample_ids[j]}\t"
+                  f"{res.sims[t]:.4f}")
+    if args.timings:
+        print(json.dumps(timer.report(), sort_keys=True),
+              file=sys.stderr)
     return 0
 
 

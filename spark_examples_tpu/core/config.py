@@ -48,6 +48,12 @@ TILE2D_TRANSPORTS = ("auto", "gather", "ring")
 EIGH_MODES = ("auto", "dense", "randomized")
 BRAYCURTIS_METHODS = ("auto", "exact", "matmul", "pallas")
 PACK_STREAMS = ("auto", "packed", "dense")
+# Sparse-neighbor output shapes (spark_examples_tpu/neighbors; the
+# --neighbors-output flag): "topk" writes per-sample k-nearest rows
+# (TopKResult), "pairs" writes the deduplicated candidate pair list
+# with exact similarities. Declared here so config-time validation and
+# the CLI's argparse choices read the same tuple.
+NEIGHBORS_OUTPUTS = ("topk", "pairs")
 
 # Single source of truth for the randomized-eigh accuracy-contract
 # defaults (BASELINE.md "Randomized-solver accuracy"): the CLI flags,
@@ -339,6 +345,19 @@ class ComputeConfig:
     sketch_rank: int = SKETCH_RANK_DEFAULT  # probe columns (>= num_pc)
     sketch_iters: int = SKETCH_ITERS_DEFAULT  # extra passes (corrected)
     sketch_seed: int = 0  # probe RNG seed (resume must keep it)
+    # Sparse top-k neighbor engine (spark_examples_tpu/neighbors; the
+    # `neighbors` verb): MinHash signatures over variant carrier sets
+    # are folded into the streamed pass, LSH-banded into candidate
+    # pairs, and only candidates pay exact kernel evaluation. hashes
+    # must divide evenly into bands (each band hashes/bands rows);
+    # bucket_cap bounds any one band bucket's contribution to the
+    # candidate set (overflow counted, never silently unbounded).
+    neighbors_output: str = "topk"  # topk | pairs
+    neighbors_k: int = 10  # neighbors kept per sample (topk output)
+    minhash_hashes: int = 128  # signature length (k permutations)
+    minhash_bands: int = 32  # LSH bands (hashes % bands == 0)
+    minhash_seed: int = 0  # permutation seed (resume must keep it)
+    minhash_bucket_cap: int = 64  # max samples per band bucket
 
     def __post_init__(self):
         # Solver-knob validation AT CONFIG TIME, with the flag named —
@@ -397,6 +416,31 @@ class ComputeConfig:
                "rung; each is one full pass over the cohort")
         _check("--sketch-seed", self.sketch_seed, -(2 ** 63), 2 ** 63 - 1,
                "probe RNG seed; a resumed job must keep it")
+        _check_enum("--neighbors-output", self.neighbors_output,
+                    NEIGHBORS_OUTPUTS,
+                    "topk = per-sample k-nearest rows, pairs = the "
+                    "deduplicated candidate pair list with exact "
+                    "similarities")
+        _check("--neighbors-k", self.neighbors_k, 1, 65536,
+               "neighbors kept per sample; clamped to N-1 at run time")
+        _check("--minhash-hashes", self.minhash_hashes, 1, 65536,
+               "MinHash signature length (k permutations)")
+        _check("--minhash-bands", self.minhash_bands, 1, 65536,
+               "LSH bands; each band hashes/bands signature rows")
+        _check("--minhash-seed", self.minhash_seed,
+               -(2 ** 63), 2 ** 63 - 1,
+               "permutation seed; a resumed job must keep it")
+        _check("--minhash-bucket-cap", self.minhash_bucket_cap, 1, 1 << 20,
+               "max samples admitted per band bucket; overflow is "
+               "counted in neighbors.bucket_overflows")
+        if self.minhash_hashes % self.minhash_bands != 0:
+            raise ValueError(
+                f"bad compute config: --minhash-hashes="
+                f"{self.minhash_hashes} is not a multiple of "
+                f"--minhash-bands={self.minhash_bands} — LSH banding "
+                "slices the signature into equal bands of "
+                "hashes/bands rows each"
+            )
         # Unknown metrics die HERE with the registered kernels named —
         # the kernel registry is the single source of truth, so this
         # message can never go stale against the actual metric set.

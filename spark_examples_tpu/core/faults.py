@@ -96,6 +96,15 @@ Sites instrumented in production code:
                             the slot must back off exponentially and
                             the flap breaker must park it rather than
                             spawn-loop
+``neighbors.candidates``    per candidate-evaluation attempt in the
+                            neighbor engine's exact pass (neighbors/
+                            engine.py), fired inside the per-block
+                            retry boundary BEFORE the pair statistics
+                            accumulate — ``io_error`` must recover
+                            bit-identically (the block's contribution
+                            is recomputed from scratch on retry),
+                            ``delay`` is a slow gather of candidate
+                            rows, ``kill`` a preemption mid-evaluation
 ``trace.export``            per flight-recorder artifact write: the
                             slowest-request exemplar file (core/
                             telemetry.py requests.json) and each fleet
@@ -152,6 +161,7 @@ SITES = (
     "controller.scrape",
     "controller.spawn",
     "trace.export",
+    "neighbors.candidates",
 )
 
 # Distinctive exit code for the "kill" kind so tests can tell an injected

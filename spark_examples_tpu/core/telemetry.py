@@ -870,6 +870,36 @@ NAMES: dict[str, tuple[str, str]] = {
         "error budget; >= 1.0 means the budget is burning at alert "
         "rate) and breach (1 while both windows burn)",
     ),
+    "neighbors.candidate_pairs": (
+        "counter",
+        "candidate pairs emitted by LSH banding (after per-band "
+        "bucket caps and i<j dedup) — the pairs that pay exact kernel "
+        "evaluation instead of the full N(N-1)/2",
+    ),
+    "neighbors.filter_frac": (
+        "gauge",
+        "fraction of all N(N-1)/2 pairs the LSH filter AVOIDED "
+        "evaluating exactly (1 - candidates/all); higher is better — "
+        "the whole point of the MinHash screen",
+    ),
+    "neighbors.bucket_overflows": (
+        "counter",
+        "samples dropped from over-cap LSH band buckets "
+        "(--minhash-bucket-cap): a crowded bucket (monomorphic band, "
+        "degenerate signature) is truncated deterministically, never "
+        "allowed to regenerate the quadratic pair set",
+    ),
+    "neighbors.evaluated_pairs": (
+        "counter",
+        "candidate pairs whose exact per-pair kernel statistics were "
+        "accumulated through the streamed candidate-evaluation pass "
+        "(equals neighbors.candidate_pairs on a clean run)",
+    ),
+    "neighbors.requests": (
+        "counter",
+        "top-k neighbor requests answered by the serving layer (the "
+        "/neighbors endpoint and the in-process fleet.topk path)",
+    ),
 }
 
 _FAMILIES = tuple(n[:-1] for n in NAMES if n.endswith(".*"))  # "phase."

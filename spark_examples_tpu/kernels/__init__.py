@@ -10,6 +10,7 @@ from spark_examples_tpu.kernels.base import (  # noqa: F401
     DualSketch,
     FactorSketch,
     Kernel,
+    PairSpec,
     all_kernels,
     check_sketchable,
     dual_sketch_names,
@@ -18,6 +19,7 @@ from spark_examples_tpu.kernels.base import (  # noqa: F401
     gram_names,
     maybe_get,
     names,
+    pairable_names,
     register,
     unregister,
     unsketchable_metric_error,

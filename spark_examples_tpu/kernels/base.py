@@ -113,6 +113,21 @@ class CrossSpec:
 
 
 @dataclass(frozen=True)
+class PairSpec:
+    """Sparse pairwise evaluability (the neighbors subsystem): ``stats``
+    are :data:`ops.genotype.CROSS_STATS` names accumulated PER PAIR
+    (both orientations spelled out — e.g. ``sn``/``sr`` rather than a
+    transposed dense half); ``sim(acc)`` maps the accumulated int64
+    per-pair statistic vectors to SIMILARITIES (NumPy, elementwise over
+    the pair axis), mirroring ``np_finalize``'s off-diagonal values
+    bitwise. Declaring a PairSpec does NOT make a kernel projectable —
+    that stays ``cross`` — it makes it top-k-able."""
+
+    stats: tuple[str, ...]
+    sim: Callable
+
+
+@dataclass(frozen=True)
 class Kernel:
     """One similarity kernel, declaratively. See the module docstring
     for the field-by-field contract; ``family`` is:
@@ -143,6 +158,7 @@ class Kernel:
     flops: Callable | None = None         # (n, v) -> matmul FLOPs per block
     sketch: FactorSketch | DualSketch | None = None
     cross: CrossSpec | None = None
+    pair: PairSpec | None = None
     # float family hooks (all lazy-importing; None for count/table).
     acc_leaves_: tuple[str, ...] | None = None
     scalar_leaves: tuple[str, ...] = ()   # replicated (not tiled) leaves
@@ -271,6 +287,12 @@ def dual_sketch_names() -> tuple[str, ...]:
     """Ratio kernels streamable as a num/den dual sketch."""
     return tuple(k.name for k in _REGISTRY.values()
                  if isinstance(k.sketch, DualSketch))
+
+
+def pairable_names() -> tuple[str, ...]:
+    """Kernels whose similarity can be evaluated per candidate pair
+    (declared a PairSpec) — the metrics the neighbors engine serves."""
+    return tuple(k.name for k in _REGISTRY.values() if k.pair is not None)
 
 
 def unsketchable_names() -> tuple[str, ...]:

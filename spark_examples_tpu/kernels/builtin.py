@@ -18,6 +18,7 @@ from spark_examples_tpu.kernels.base import (
     DualSketch,
     FactorSketch,
     Kernel,
+    PairSpec,
     register,
 )
 
@@ -89,6 +90,16 @@ def _ibs_cross_d2(acc):
     return dist * dist
 
 
+def _ibs_pair_sim(acc):
+    import numpy as np
+
+    # Mirrors _ibs_np_finalize off-diagonal bitwise: dist 0 (sim 1)
+    # when a pair shares no complete variants.
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return np.where(acc["m"] > 0,
+                        1.0 - acc["d1"] / (2.0 * acc["m"]), 1.0)
+
+
 register(Kernel(
     name="ibs",
     summary="PLINK-convention identity-by-state over pairwise-complete "
@@ -114,6 +125,7 @@ register(Kernel(
         num_psd=True,
     ),
     cross=CrossSpec(stats=("m", "d1"), d2=_ibs_cross_d2),
+    pair=PairSpec(stats=("m", "d1"), sim=_ibs_pair_sim),
 ))
 
 
@@ -291,6 +303,17 @@ def _king_np_finalize(acc):
             "distance": np.maximum(0.5 - phi, 0.0)}
 
 
+def _king_pair_sim(acc):
+    import numpy as np
+
+    # Per-pair het-count denominator = hcn + hcr (the two orientations
+    # of the hc statistic), matching hc + hc^T off-diagonal bitwise.
+    # The diagonal's 0.5 pin never applies: candidate pairs are i < j.
+    den = acc["hcn"] + acc["hcr"]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return np.where(den > 0, (acc["hh"] - 2 * acc["opp"]) / den, 0.0)
+
+
 register(Kernel(
     name="king",
     summary="KING-robust kinship (relatedness QC: dup ~0.5, "
@@ -306,7 +329,10 @@ register(Kernel(
     # No sketch spec: phi's numerator (hh - 2*opp) is indefinite AND
     # its het-count denominator is far from rank-1 (zero-het samples),
     # so neither sketch form applies — exact rung only, and the
-    # registry-derived rejection says so.
+    # registry-derived rejection says so. No cross spec either (a
+    # PairSpec deliberately does not make king PROJECTABLE), but the
+    # per-pair statistics exist, so top-k relatedness screening works.
+    pair=PairSpec(stats=("hh", "opp", "hcn", "hcr"), sim=_king_pair_sim),
 ))
 
 
@@ -364,6 +390,18 @@ def _jaccard_cross_d2(acc):
     return jnp.maximum(2.0 - 2.0 * sim, 0.0)
 
 
+def _jaccard_pair_sim(acc):
+    import numpy as np
+
+    # Per-pair union = sn + sr - s (both orientations of the sc
+    # statistic spelled out) — the same integers _jaccard_np_finalize
+    # gets from sc + sc^T - s on the dense route, so the similarity
+    # matches bitwise.
+    union = acc["sn"] + acc["sr"] - acc["s"]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return np.where(union > 0, acc["s"] / union, 1.0)
+
+
 register(Kernel(
     name="jaccard",
     summary="carrier-set Jaccard similarity over pairwise-complete "
@@ -392,6 +430,7 @@ register(Kernel(
         num_psd=True,
     ),
     cross=CrossSpec(stats=("s", "sn", "sr"), d2=_jaccard_cross_d2),
+    pair=PairSpec(stats=("s", "sn", "sr"), sim=_jaccard_pair_sim),
 ))
 
 

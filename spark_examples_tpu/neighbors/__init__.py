@@ -1,0 +1,13 @@
+"""Sparse nearest-neighbor engine: streamed MinHash/LSH candidate
+filtering with exact top-k outputs (see engine.py for the three-stage
+story). jax is imported lazily by the stages that need it; the output
+formats and the LSH math are host-only.
+"""
+
+from spark_examples_tpu.neighbors.output import (  # noqa: F401
+    NeighborFormatError,
+    PairsResult,
+    TopKResult,
+    load_result,
+    save_result,
+)

@@ -1,0 +1,269 @@
+"""The neighbor engine: MinHash pass -> LSH candidates -> exact top-k.
+
+Three stages, each deterministic, so the whole job is:
+
+1. **Signatures** (:func:`minhash_signatures`): the streamed MinHash
+   pass over the cohort — rides ``runner.run_sketch_pass`` (same
+   staged-ring feed, ``gram.block`` spans, cursors) and checkpoints its
+   ``sig``/``nvar`` leaves under the ``solver:minhash`` tag at the
+   job's ``--checkpoint-every-blocks`` cadence, so a killed run resumes
+   from the cursor bit-identically (tests/test_kill_matrix.py).
+2. **Candidates** (lsh.py): banding over the signatures on the host —
+   the filter. ``neighbors.filter_frac`` reports the share of all
+   N(N-1)/2 pairs it avoided.
+3. **Exact evaluation** (:func:`_pair_stats_stream`): a second streamed
+   variant pass that accumulates the registered kernel's PairSpec
+   cross-statistics for ONLY the candidate pairs — int64 sums of the
+   same integer products the dense gram accumulates, so the pair
+   similarities out of ``PairSpec.sim`` equal the dense matrix's
+   off-diagonal entries bit for bit (tests pin this). Each block's
+   contribution runs inside a retry boundary (the
+   ``neighbors.candidates`` fault site): a transient IO error recomputes
+   the block's contribution from scratch, so recovery is bit-identical
+   by construction.
+
+The output is sparse — per-sample top-k rows or the evaluated edge
+list (output.py) — ALONGSIDE the dense routes, never replacing them:
+``similarity`` still produces the full matrix; ``neighbors`` is the
+O(N k) answer for cohorts where N x N is not worth materializing.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+
+import numpy as np
+
+import jax
+
+from spark_examples_tpu import kernels
+from spark_examples_tpu.core import checkpoint as ckpt
+from spark_examples_tpu.core import faults, meshes, telemetry
+from spark_examples_tpu.core.config import JobConfig
+from spark_examples_tpu.core.profiling import PhaseTimer
+from spark_examples_tpu.neighbors import lsh
+from spark_examples_tpu.neighbors import minhash as M
+from spark_examples_tpu.neighbors.output import PairsResult, TopKResult
+from spark_examples_tpu.ops import genotype
+from spark_examples_tpu.pipelines import runner as R
+from spark_examples_tpu.solvers.driver import sketch_plan
+
+# Checkpoint namespace for the signature pass — the sketch solvers'
+# ``solver:<metric>`` convention, so a minhash checkpoint can never be
+# resumed into a gram or sketch-solver job (or vice versa).
+METRIC_TAG = "solver:minhash"
+
+# Host pair-evaluation chunk: bounds the (pairs, v) gather at ~128 MB
+# for 8192-variant blocks without changing any result (int64 adds are
+# associative over the chunk split).
+_PAIR_CHUNK = 8192
+
+
+def minhash_signatures(job: JobConfig, source, timer: PhaseTimer,
+                       plan=None) -> tuple[np.ndarray, int]:
+    """The streamed signature pass: ``((N, k) uint32 signatures,
+    n_variants)``. Checkpointable and resumable exactly like a sketch
+    solver pass (module docstring)."""
+    cfg = job.compute
+    if plan is None:
+        plan = sketch_plan(job)
+    n = source.n_samples
+    hashes, seed = cfg.minhash_hashes, cfg.minhash_seed
+    update = M.make_update(plan, hashes, seed, packed=False)
+    bv = job.ingest.block_variants
+    # The manifest extras pin every knob the signatures depend on — a
+    # checkpoint from a different seed/hash-count (different hash
+    # family, incompatible state) can never be resumed into this job.
+    extra = {"solver": "minhash", "hashes": int(hashes),
+             "bands": int(cfg.minhash_bands), "seed": int(seed)}
+
+    state, start_variant = None, 0
+    if cfg.checkpoint_dir:
+        restored = ckpt.load(cfg.checkpoint_dir, METRIC_TAG,
+                             source.sample_ids, block_variants=bv,
+                             leaves=list(M.STATE_LEAVES),
+                             expect_extra=extra)
+        if restored is not None:
+            acc, start_variant, _stats = restored
+            repl = meshes.replicated(plan.mesh)
+            state = {k: jax.device_put(np.asarray(v), repl)
+                     for k, v in acc.items()}
+    if state is None:
+        state = M.init_state(plan, n, hashes)
+
+    cb = None
+    if cfg.checkpoint_dir and cfg.checkpoint_every_blocks:
+        def cb(st, cursor):
+            ckpt.save(cfg.checkpoint_dir, dict(st), cursor, METRIC_TAG,
+                      bv, source.sample_ids, extra=extra)
+
+    with telemetry.span("solver.pass", cat="solver", index=0,
+                        rung="minhash"):
+        state, n_variants = R.run_sketch_pass(
+            job, source, timer, plan, update, state,
+            start_variant=start_variant, packed=False,
+            # One compare+select per hash per variant column plus the
+            # carrier test — honest O(N v + k v) credit, nothing like
+            # the gram count.
+            block_flops=lambda v: 1.0 * n * v + 1.0 * hashes * v,
+            save_cb=cb,
+        )
+    return np.asarray(state["sig"]), n_variants
+
+
+def _np_operands(block: np.ndarray) -> dict[str, np.ndarray]:
+    """Host twin of ``ops.genotype.operands`` for the indicator
+    operands every PairSpec stat is built from (c/t1/t2/y). MISSING
+    (-1) and padding rows contribute zeros — identical to the device
+    operands, which is what makes the int64 pair sums equal the int32
+    gram entries exactly."""
+    g = np.asarray(block)
+    c = (g >= 0).astype(np.uint8)
+    t1 = (g >= 1).astype(np.uint8)
+    t2 = (g >= 2).astype(np.uint8)
+    return {"c": c, "t1": t1, "t2": t2, "y": t1 + t2}
+
+
+def _block_pair_stats(block: np.ndarray, ii: np.ndarray, jj: np.ndarray,
+                      stats: tuple[str, ...]) -> dict[str, np.ndarray]:
+    """One block's exact contribution to the candidate pairs' cross
+    statistics: for each stat, ``sum_terms w * <opL[i], opR[j]>`` over
+    the block's variants, int64. Pure — the retry boundary recomputes
+    it wholesale on an injected IO error."""
+    ops = _np_operands(block)
+    out = {s: np.zeros(len(ii), np.int64) for s in stats}
+    for lo in range(0, len(ii), _PAIR_CHUNK):
+        sl = slice(lo, lo + _PAIR_CHUNK)
+        for s in stats:
+            acc = out[s][sl]
+            for (l, r), w in genotype.CROSS_STATS[s]:
+                prod = np.einsum("pv,pv->p", ops[l][ii[sl]],
+                                 ops[r][jj[sl]], dtype=np.int64)
+                acc += w * prod
+    return out
+
+
+def _pair_stats_stream(job: JobConfig, source, timer: PhaseTimer,
+                       pairs: np.ndarray,
+                       stats: tuple[str, ...]) -> dict[str, np.ndarray]:
+    """The exact-evaluation pass: stream the cohort once more and
+    accumulate each candidate pair's cross statistics block by block.
+
+    Every block attempt runs through the ``neighbors.candidates`` fault
+    site and an IO retry boundary sized by ``--io-retries`` /
+    ``--io-retry-backoff-s`` (the ingest stream's own knobs): a
+    transient error discards the attempt and recomputes the block's
+    contribution from scratch, so the accumulated statistics — and
+    therefore the final top-k bytes — are identical to a fault-free
+    run."""
+    ii = np.ascontiguousarray(pairs[:, 0])
+    jj = np.ascontiguousarray(pairs[:, 1])
+    acc = {s: np.zeros(len(ii), np.int64) for s in stats}
+    budget = max(0, job.ingest.io_retries)
+    backoff = max(0.0, job.ingest.io_retry_backoff_s)
+    with timer.phase("neighbors_eval"):
+        for block, _meta in source.blocks(job.ingest.block_variants):
+            attempt = 0
+            while True:
+                try:
+                    faults.fire("neighbors.candidates")
+                    contrib = _block_pair_stats(block, ii, jj, stats)
+                    break
+                except IOError as e:
+                    if attempt >= budget:
+                        raise
+                    attempt += 1
+                    warnings.warn(
+                        "neighbors candidate evaluation hit a transient "
+                        f"IO error ({e!r}); recomputing the block "
+                        f"(attempt {attempt}/{budget})",
+                        RuntimeWarning, stacklevel=2,
+                    )
+                    if backoff > 0.0:
+                        time.sleep(min(backoff * attempt, 30.0))
+            for s in stats:
+                acc[s] += contrib[s]
+    return acc
+
+
+def topk_rows(sims: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """(b, N) similarity rows -> ``(ids, vals)`` of shape
+    ``(b, min(k, N))``: descending similarity, ties broken by ascending
+    column index (stable argsort over the negated values). THE top-k
+    reduction — the offline cohort job, the offline query-vs-panel path
+    and the fleet's ``/neighbors`` route all funnel through it, so
+    served answers are bit-identical to the CLI's by construction."""
+    sims = np.asarray(sims, np.float64)
+    kk = min(int(k), sims.shape[1])
+    order = np.argsort(-sims, axis=1, kind="stable")[:, :kk]
+    vals = np.take_along_axis(sims, order, axis=1)
+    return order.astype(np.int32), vals
+
+
+def topk_from_pairs(pairs: np.ndarray, sims: np.ndarray, n: int,
+                    k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Evaluated candidate edges -> per-sample ``(ids, vals)`` of shape
+    (n, k): each sample's k best candidate neighbors, descending
+    similarity with ties broken by ascending neighbor id; rows with
+    fewer than k candidates pad with id -1 / sim 0.0."""
+    ids = np.full((n, k), -1, np.int32)
+    vals = np.zeros((n, k), np.float64)
+    nbrs: list[list[tuple[float, int]]] = [[] for _ in range(n)]
+    for (i, j), s in zip(pairs, sims):
+        s = float(s)
+        nbrs[int(i)].append((-s, int(j)))
+        nbrs[int(j)].append((-s, int(i)))
+    for i in range(n):
+        if not nbrs[i]:
+            continue
+        best = sorted(nbrs[i])[:k]
+        ids[i, :len(best)] = [j for _neg, j in best]
+        vals[i, :len(best)] = [-neg for neg, _j in best]
+    return ids, vals
+
+
+def neighbors_job(job: JobConfig, source=None,
+                  timer: PhaseTimer | None = None):
+    """Run the full neighbor job for a cohort: signatures, candidates,
+    exact evaluation, sparse reduction. Returns a
+    :class:`~spark_examples_tpu.neighbors.output.TopKResult` or
+    :class:`~spark_examples_tpu.neighbors.output.PairsResult` per
+    ``--neighbors-output``."""
+    if timer is None:
+        timer = PhaseTimer()
+    cfg = job.compute
+    if source is None:
+        with timer.phase("ingest_setup"):
+            source = R.build_source(job.ingest)
+    metric = cfg.metric or "ibs"
+    kern = kernels.get(metric)
+    if kern.pair is None:
+        raise ValueError(
+            f"metric {metric!r} has no pairwise finalize — top-k "
+            "neighbors needs a kernel with a PairSpec; currently: "
+            f"{', '.join(kernels.pairable_names())}"
+        )
+    n = source.n_samples
+    sig, n_variants = minhash_signatures(job, source, timer)
+    with timer.phase("lsh"):
+        pairs, n_overflow, _nb = lsh.candidate_pairs(
+            sig, cfg.minhash_bands, cfg.minhash_bucket_cap)
+    telemetry.count("neighbors.candidate_pairs", float(len(pairs)))
+    telemetry.count("neighbors.bucket_overflows", float(n_overflow))
+    telemetry.gauge_set("neighbors.filter_frac",
+                        lsh.filter_fraction(len(pairs), n))
+    acc = _pair_stats_stream(job, source, timer, pairs, kern.pair.stats)
+    sims = np.asarray(kern.pair.sim(acc), np.float64)
+    telemetry.count("neighbors.evaluated_pairs", float(len(pairs)))
+    if cfg.neighbors_output == "pairs":
+        return PairsResult(
+            pairs=pairs, sims=sims,
+            sample_ids=tuple(source.sample_ids), metric=metric,
+            n_variants=n_variants,
+        )
+    ids, vals = topk_from_pairs(pairs, sims, n, cfg.neighbors_k)
+    return TopKResult(
+        ids=ids, sims=vals, sample_ids=tuple(source.sample_ids),
+        metric=metric, k=cfg.neighbors_k, n_variants=n_variants,
+    )

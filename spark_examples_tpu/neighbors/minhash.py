@@ -1,0 +1,144 @@
+"""Streamed MinHash signatures over variant carrier sets.
+
+Each sample's "document" is the set of variants it carries an alternate
+allele at (``G >= 1`` — the same indicator the shared-alt kernel
+streams). A k-permutation MinHash sketches that set into a fixed
+``(N, k)`` uint32 signature whose per-column collision probability is
+the Jaccard similarity of the carrier sets — the classic
+candidate-filtering bound the LSH banding stage (lsh.py) exploits.
+
+The permutations are the standard multiply-add family over the uint32
+ring: ``h_i(j) = a_i * j + b_i (mod 2**32)`` with odd ``a_i``, both
+derived deterministically from ``--minhash-seed`` and — like the sketch
+solver's probes — recomputed on resume, never checkpointed. ``j`` is
+the variant's GLOBAL stream index (checkpoint cursor + in-block
+offset), so a kill/restart/resume run hashes every variant to exactly
+the same values as an uninterrupted one: resume bit-identity is by
+construction, not by replaying state.
+
+The state is a plain accumulator dict (``sig``/``nvar``) so it rides
+``runner.run_sketch_pass`` and the existing checkpoint machinery
+unchanged — the same staged-ring feed, ``gram.block`` spans, cursors,
+and ``solver:minhash`` checkpoint leaves as any sketch-solver pass.
+Padding columns (all MISSING) carry no alt calls, so they update no
+signature — but they DO consume index slots, which is fine: the index
+stream is deterministic for a fixed block partition, and the partition
+is pinned by ``--block-variants`` (the same invariant every resumable
+pass in this repo already relies on).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_examples_tpu.core import meshes
+from spark_examples_tpu.parallel.gram_sharded import GramPlan
+
+# Checkpointable accumulator leaves (core/checkpoint.py saves them like
+# any sketch state; hashes/bands/seed ride in the manifest's extra).
+STATE_LEAVES = ("nvar", "sig")
+
+_UMAX = np.uint32(0xFFFFFFFF)
+
+
+def hash_params(hashes: int, seed: int) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic ``(a, b)`` uint32 multiply-add coefficients for the
+    k permutations — ``a`` forced odd (a unit of the uint32 ring, so
+    each h_i is a bijection on variant indices). Recomputed from
+    ``--minhash-seed`` on resume, never checkpointed (the signature
+    state that IS checkpointed already absorbed them)."""
+    rng = np.random.default_rng(np.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+    a = rng.integers(0, 1 << 32, size=hashes, dtype=np.uint32) | np.uint32(1)
+    b = rng.integers(0, 1 << 32, size=hashes, dtype=np.uint32)
+    return a, b
+
+
+def _update_impl(state, block, a, b, packed: bool):
+    """One block into the signatures: for every hash i,
+    ``sig[:, i] = min(sig[:, i], min over carried variants of h_i(j))``.
+
+    The hash loop is a lax.scan so the live intermediate stays
+    O(N * v + k * N) — the naive broadcast would materialize an
+    (N, k, v) tensor, ~1.3 GB at N=2.5k, k=128, v=1024. Under a
+    multi-device plan the block arrives variant-sharded exactly as in
+    the gram path; the min over the sharded variant axis is the
+    collective, the signature state stays replicated."""
+    if packed:
+        from spark_examples_tpu.ingest.bitpack import unpack_dosages
+
+        block = unpack_dosages(block)
+    carriers = block >= 1  # (N, v); MISSING (-1) and padding are inert
+    idx = state["nvar"] + jnp.arange(block.shape[1], dtype=jnp.uint32)
+
+    def body(_, ab):
+        a_i, b_i = ab
+        h = a_i * idx + b_i  # uint32 wraparound — the permutation
+        return None, jnp.min(
+            jnp.where(carriers, h[None, :], _UMAX), axis=1)
+
+    _, mins = jax.lax.scan(body, None, (a, b))  # (k, N)
+    return {
+        "nvar": state["nvar"] + jnp.uint32(block.shape[1]),
+        "sig": jnp.minimum(state["sig"], mins.T),
+    }
+
+
+@lru_cache(maxsize=64)
+def _jitted_update(plan: GramPlan, hashes: int, seed: int, packed: bool):
+    repl = meshes.replicated(plan.mesh)
+    state_sh = {"nvar": repl, "sig": repl}
+    a, b = hash_params(hashes, seed)
+    return jax.jit(
+        partial(_update_impl, a=jnp.asarray(a), b=jnp.asarray(b),
+                packed=packed),
+        in_shardings=(state_sh, plan.block_sharding),
+        out_shardings=state_sh,
+        donate_argnums=(0,),
+    )
+
+
+def make_update(plan: GramPlan, hashes: int, seed: int,
+                packed: bool = False):
+    """Jitted ``(state, block) -> state`` with the plan's block transport
+    pinned — the MinHash twin of ``sketch.make_update``, same host-block
+    padding/placement handling."""
+    jitted = _jitted_update(plan, hashes, seed, packed)
+    n_shards = plan.block_shards
+
+    def update(state, block):
+        if not (isinstance(block, jax.Array)
+                and block.sharding == plan.block_sharding):
+            block = np.asarray(block)
+            if block.shape[1] % n_shards:
+                from spark_examples_tpu.ingest.prefetch import (
+                    pad_block, pad_packed,
+                )
+
+                width = -(-block.shape[1] // n_shards) * n_shards
+                block = (pad_packed(block, width) if packed
+                         else pad_block(block, width))
+            block = jax.device_put(block, plan.block_sharding)
+        return jitted(state, block)
+
+    return update
+
+
+def init_state(plan: GramPlan, n: int, hashes: int) -> dict:
+    """Fresh signature state: all-ones signatures (the identity of the
+    running min), zero variant cursor."""
+    repl = meshes.replicated(plan.mesh)
+    return {
+        "nvar": jax.device_put(jnp.zeros((), jnp.uint32), repl),
+        "sig": jax.device_put(
+            jnp.full((n, hashes), _UMAX, jnp.uint32), repl),
+    }
+
+
+def state_bytes(n: int, hashes: int) -> int:
+    """Signature-state residency: one (N, k) uint32 leaf — the number
+    bench compares against the dense route's N x N accumulators."""
+    return n * hashes * 4

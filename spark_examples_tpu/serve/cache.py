@@ -28,6 +28,12 @@ import numpy as np
 from spark_examples_tpu.core.hashing import array_digest
 
 
+def _nbytes(value) -> int:
+    if isinstance(value, tuple):
+        return sum(v.nbytes for v in value)
+    return value.nbytes
+
+
 def genotype_digest(genotypes: np.ndarray, namespace: str = "") -> str:
     """Content digest of one query's genotype block.
 
@@ -68,25 +74,33 @@ class ResultCache:
                 self._data.move_to_end((namespace, key))
             return value
 
-    def put(self, key: str, value: np.ndarray,
+    def put(self, key: str, value,
             namespace: str = "") -> None:
         if self.capacity == 0:
             return
         # A genuine copy, not ascontiguousarray: freezing an alias of
         # the caller's array would make the Future result handed to the
-        # client read-only whenever caching happens to be on.
-        frozen = np.array(value)
-        frozen.setflags(write=False)
+        # client read-only whenever caching happens to be on. Values
+        # are one array (projection rows) or a tuple of arrays (topk's
+        # (ids, sims) — np.array over the tuple would STACK it into one
+        # float64 block and silently destroy the ids' dtype).
+        if isinstance(value, tuple):
+            frozen = tuple(np.array(v) for v in value)
+            for v in frozen:
+                v.setflags(write=False)
+        else:
+            frozen = np.array(value)
+            frozen.setflags(write=False)
         with self._lock:
             old = self._data.get((namespace, key))
             if old is not None:
-                self._bytes -= old.nbytes
+                self._bytes -= _nbytes(old)
             self._data[(namespace, key)] = frozen
-            self._bytes += frozen.nbytes
+            self._bytes += _nbytes(frozen)
             self._data.move_to_end((namespace, key))
             while len(self._data) > self.capacity:
                 _, evicted = self._data.popitem(last=False)
-                self._bytes -= evicted.nbytes
+                self._bytes -= _nbytes(evicted)
 
     def evict_namespace(self, namespace: str) -> int:
         """Drop every entry of ``namespace`` (a route's whole cache
@@ -94,7 +108,7 @@ class ResultCache:
         with self._lock:
             doomed = [k for k in self._data if k[0] == namespace]
             for k in doomed:
-                self._bytes -= self._data.pop(k).nbytes
+                self._bytes -= _nbytes(self._data.pop(k))
             return len(doomed)
 
     def stats(self) -> dict:

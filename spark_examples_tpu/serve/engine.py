@@ -38,6 +38,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from spark_examples_tpu import kernels
 from spark_examples_tpu.pipelines import project as P
 from spark_examples_tpu.serve.health import CircuitBreaker
 
@@ -123,6 +124,84 @@ def batch_coords(ctx: ModelContext, ref_blocks, genotypes: np.ndarray,
         acc = P._update_cross(acc, q, ref_dev)
     rows = [np.asarray(ctx.finalize_row(acc, i)) for i in range(b)]
     return np.concatenate(rows, axis=0)
+
+
+def check_topkable(model) -> "kernels.PairSpec":
+    """The gate for the ``topk`` route capability: the model's metric
+    must carry a pairwise finalize (kernels.PairSpec — jaccard/ibs/
+    king). PCA models have no similarity metric at all; projectable
+    metrics without a PairSpec can project but not rank neighbors."""
+    metric = getattr(model, "metric", None)
+    if model.kind == "pca" or not metric:
+        raise ValueError(
+            "topk serving needs a metric-bearing (pcoa) model — PCA "
+            "models carry no pairwise similarity to rank neighbors by"
+        )
+    spec = kernels.get(metric).pair
+    if spec is None:
+        raise ValueError(
+            f"metric {metric!r} has no pairwise finalize — topk routes "
+            f"support: {', '.join(kernels.pairable_names())}"
+        )
+    return spec
+
+
+def batch_pair_sims(ctx: ModelContext, ref_blocks,
+                    genotypes: np.ndarray, max_batch: int,
+                    n_variants: int) -> np.ndarray:
+    """(b, V) int8 query genotypes -> (b, N_ref) float64 EXACT pairwise
+    similarities against the staged panel, through the same padded-batch
+    cross-statistics accumulation as :func:`batch_coords` — int32 sums
+    of int8 products, exact for any block partition and batch shape, so
+    each live row equals the offline query-vs-panel accumulator bit for
+    bit; the host-side PairSpec finalize then runs on identical
+    integers. The offline ``neighbors`` CLI query mode calls THIS
+    function, which is what makes served answers bit-identical to it by
+    construction rather than by test luck."""
+    spec = check_topkable(ctx.model)
+    g = np.ascontiguousarray(genotypes, dtype=np.int8)
+    if g.ndim != 2 or g.shape[1] != n_variants:
+        raise ValueError(
+            f"query batch must be (b, {n_variants}) int8 dosages, "
+            f"got {g.shape}"
+        )
+    b = g.shape[0]
+    if not 1 <= b <= max_batch:
+        raise ValueError(
+            f"batch of {b} rows outside [1, {max_batch}]"
+        )
+    if b < max_batch:
+        g = np.concatenate(
+            [g, np.zeros((max_batch - b, n_variants), np.int8)], axis=0)
+    acc = {
+        k: jnp.zeros((max_batch, ctx.n_ref), jnp.int32)
+        for k in spec.stats
+    }
+    for ref_dev, meta in ref_blocks:
+        q = jax.device_put(
+            np.ascontiguousarray(g[:, meta.start:meta.stop]))
+        acc = P._update_cross(acc, q, ref_dev)
+    # int64 on the host — same integer values as the int32 device sums
+    # (the budget guard bounds them), and the same dtype the offline
+    # cohort engine accumulates in, so the float64 finalize is bitwise
+    # the same arithmetic.
+    host = {k: np.asarray(v[:b]).astype(np.int64)
+            for k, v in acc.items()}
+    return np.asarray(spec.sim(host), np.float64)
+
+
+def batch_topk(ctx: ModelContext, ref_blocks, genotypes: np.ndarray,
+               max_batch: int, n_variants: int,
+               k: int) -> tuple[np.ndarray, np.ndarray]:
+    """(b, V) queries -> ``(ids, sims)`` of shape (b, min(k, N_ref)):
+    each query's k nearest panel samples by exact similarity,
+    descending, ties by ascending panel index — the serving twin of the
+    offline top-k reduction (neighbors/engine.py ``topk_rows``)."""
+    from spark_examples_tpu.neighbors.engine import topk_rows
+
+    sims = batch_pair_sims(ctx, ref_blocks, genotypes, max_batch,
+                           n_variants)
+    return topk_rows(sims, k)
 
 
 def stage_blocks(source_ref, block_variants: int) -> tuple[list, int, int]:

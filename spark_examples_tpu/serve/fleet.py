@@ -13,9 +13,15 @@ share::
          "source": "store:/data/eur.store"},
         {"name": "afr-panel", "model": "afr.npz",
          "source": "packed", "path": "/data/afr_packed",
-         "block_variants": 4096}
+         "block_variants": 4096, "topk": true}
       ]
     }
+
+A route with ``"topk": true`` additionally answers ``POST /neighbors``
+(exact query-vs-panel nearest neighbors through the model metric's
+pairwise finalize) — validated at load: the model must carry a
+pairable metric (kernels.PairSpec), so a capability the model cannot
+honor dies at startup, not on the first request.
 
 ``source`` takes the same spellings as the CLI ``--source`` family
 (``store:<dir>`` shorthand included — IngestConfig normalizes it);
@@ -60,6 +66,7 @@ class RouteSpec:
     source: str
     path: str | None = None
     block_variants: int | None = None
+    topk: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,17 +116,24 @@ class FleetManifest:
                 )
             seen.add(r["name"])
             unknown = set(r) - {"name", "model", "source", "path",
-                                "block_variants"}
+                                "block_variants", "topk"}
             if unknown:
                 raise FleetFormatError(
                     f"fleet manifest {origin}: routes[{i}] "
                     f"({r['name']!r}) has unknown field(s) "
                     f"{sorted(unknown)}"
                 )
+            if not isinstance(r.get("topk", False), bool):
+                raise FleetFormatError(
+                    f"fleet manifest {origin}: routes[{i}] "
+                    f"({r['name']!r}) topk={r['topk']!r} — expected "
+                    "true/false"
+                )
             specs.append(RouteSpec(
                 name=r["name"], model=r["model"], source=r["source"],
                 path=r.get("path"),
                 block_variants=r.get("block_variants"),
+                topk=r.get("topk", False),
             ))
         unknown_top = set(doc) - {"routes", "budget_mb", "max_batch",
                                   "block_variants", "slos"}
@@ -192,6 +206,14 @@ def build_route(spec: RouteSpec, ingest_defaults: IngestConfig,
     from spark_examples_tpu.serve.router import _close_source
 
     ctx = E.ModelContext(P.load_model(spec.model))
+    if spec.topk:
+        try:
+            E.check_topkable(ctx.model)
+        except ValueError as e:
+            raise FleetFormatError(
+                f"fleet manifest: route {spec.name!r} declares the "
+                f"'topk' capability its model cannot honor — {e}"
+            ) from None
     panel_cfg = dataclasses.replace(
         ingest_defaults, source=spec.source, path=spec.path,
         block_variants=(spec.block_variants or default_block_variants),
@@ -213,6 +235,7 @@ def build_route(spec: RouteSpec, ingest_defaults: IngestConfig,
         panel_source_fn=panel_source_fn,
         block_variants=panel_cfg.block_variants,
         n_variants=n_variants,
+        topk=spec.topk,
     )
 
 

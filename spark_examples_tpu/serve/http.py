@@ -205,9 +205,12 @@ def _make_fleet_handler(fleet):
     """The fleet front (serve --fleet): same endpoints as the
     single-model handler plus route addressing — ``POST /project``
     takes ``route`` (and optional ``priority``) in the body, or the
-    route rides the path as ``POST /project/<route>``; ``GET /routes``
-    lists the registry with per-route stats; ``GET /warm/<route>``
-    stages a route's panel now (the controller's placement push)."""
+    route rides the path as ``POST /project/<route>``; ``POST
+    /neighbors`` (or ``/neighbors/<route>``, body ``k`` optional,
+    default 10) answers exact query-vs-panel top-k on routes declaring
+    the manifest ``topk`` capability; ``GET /routes`` lists the
+    registry with per-route stats; ``GET /warm/<route>`` stages a
+    route's panel now (the controller's placement push)."""
     from spark_examples_tpu.serve.pool import PanelUnavailable
     from spark_examples_tpu.serve.router import UnknownRoute
 
@@ -272,22 +275,33 @@ def _make_fleet_handler(fleet):
             self._reply(404, {"error": f"unknown path {self.path!r}"})
 
         def do_POST(self):  # noqa: N802 (stdlib API)
-            if not (self.path == "/project"
-                    or self.path.startswith("/project/")):
+            # Two verbs, one envelope: /project answers coordinates,
+            # /neighbors answers exact query-vs-panel top-k (routes
+            # declaring the manifest "topk" capability). Both take the
+            # route in the body or on the path.
+            verb = None
+            for v in ("project", "neighbors"):
+                if self.path == f"/{v}" or self.path.startswith(f"/{v}/"):
+                    verb = v
+                    break
+            if verb is None:
                 self._reply(404, {"error": f"unknown path {self.path!r}"})
                 return
             try:
                 genotypes, deadline_s, req = _parse_project_body(self)
-                route = (self.path[len("/project/"):]
-                         if self.path.startswith("/project/")
+                route = (self.path[len(f"/{verb}/"):]
+                         if self.path.startswith(f"/{verb}/")
                          else req.get("route"))
                 if not route:
                     raise ValueError(
                         "fleet request names no route (body 'route' "
-                        "field or POST /project/<route>)")
+                        f"field or POST /{verb}/<route>)")
                 kwargs = {}
                 if req.get("priority") is not None:
                     kwargs["priority"] = str(req["priority"])
+                k = 0
+                if verb == "neighbors":
+                    k = int(req.get("k", 10))
             except (ValueError, KeyError, TypeError, OverflowError) as e:
                 self._reply(400, {"error": f"bad request body: {e}"})
                 return
@@ -304,9 +318,29 @@ def _make_fleet_handler(fleet):
             try:
                 with telemetry.trace_scope(trace_id=tid,
                                            span_id=trace["span_id"]):
-                    coords = fleet.project(route, genotypes,
-                                           deadline_s=deadline_s,
-                                           trace=trace, **kwargs)
+                    if verb == "neighbors":
+                        ids, sims = fleet.topk(route, genotypes, k,
+                                               deadline_s=deadline_s,
+                                               trace=trace, **kwargs)
+                        # Panel indices -> the model's sample ids: the
+                        # client-facing identity, beside the raw
+                        # indices for positional consumers.
+                        panel_ids = fleet.routes[route].ctx.model \
+                            .sample_ids
+                        payload = {
+                            "neighbor_ids": [
+                                [panel_ids[j] for j in row]
+                                for row in ids.tolist()
+                            ],
+                            "neighbor_indices": ids.tolist(),
+                            "similarities": sims.tolist(),
+                            "k": int(ids.shape[1]),
+                        }
+                    else:
+                        coords = fleet.project(route, genotypes,
+                                               deadline_s=deadline_s,
+                                               trace=trace, **kwargs)
+                        payload = {"coords": coords.tolist()}
             except UnknownRoute as e:
                 code, payload = 404, {"error": str(e)}
             except ServerOverloaded as e:
@@ -323,8 +357,6 @@ def _make_fleet_handler(fleet):
                 code, payload = 400, {"error": str(e)}
             except Exception as e:  # answered, never a dropped socket
                 code, payload = 500, {"error": repr(e)}
-            else:
-                payload = {"coords": coords.tolist()}
             total = time.perf_counter() - t0
             phases = {**trace["phases"], "total": total}
             cls = kwargs.get("priority", "")

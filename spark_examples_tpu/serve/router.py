@@ -60,6 +60,15 @@ class UnknownRoute(ValueError):
     """Request names a route the fleet does not serve."""
 
 
+def _copy_result(value):
+    """Defensive copy of a cached/served result — (1, k) coords for
+    project requests, an (ids, sims) tuple for topk — so no caller can
+    mutate the cache's arrays in place."""
+    if isinstance(value, tuple):
+        return tuple(np.array(v) for v in value)
+    return np.array(value)
+
+
 @dataclass
 class Route:
     """One servable (model, panel) pair, by name.
@@ -75,6 +84,9 @@ class Route:
     panel_source_fn: object  # () -> GenotypeSource
     block_variants: int
     n_variants: int | None = None
+    # Manifest capability: this route also answers /neighbors (exact
+    # query-vs-panel top-k through the model metric's PairSpec).
+    topk: bool = False
     breaker: CircuitBreaker = field(default_factory=CircuitBreaker)
     # Per-class client-visible latency histograms (autoscale p99) and
     # request tallies — route-local, beside the process-wide serve.*
@@ -95,6 +107,7 @@ class Route:
         self.tally.setdefault("cancelled", 0)
         self.tally.setdefault("cache_hits", 0)
         self.tally.setdefault("stages", 0)
+        self.tally.setdefault("topk_requests", 0)
 
     @property
     def cache_ns(self) -> str:
@@ -148,6 +161,11 @@ class _Pending:
     digest: str | None
     t_submit: float
     deadline: float | None
+    # "project" -> (1, k) coordinates; "topk" -> ((1, k) neighbor
+    # indices, (1, k) exact similarities). Batches coalesce only within
+    # one kind — the two kinds run different compiled programs.
+    kind: str = "project"
+    k: int = 0  # topk only: neighbors requested
     finished: bool = False
     # Request-scoped trace context (serve/http.py mints it): the worker
     # writes phase timings into trace["phases"] BEFORE resolving the
@@ -222,15 +240,21 @@ class _PriorityQueues:
             first = self._pop_locked(cls)
             batch = [first]
             linger_until = time.perf_counter() + linger_s
+
+            def _same(p: _Pending) -> bool:
+                # Same route AND same kind: project and topk rows run
+                # different compiled programs, so a mixed batch cannot
+                # share a device step.
+                return p.route == first.route and p.kind == first.kind
+
             while len(batch) < max_batch:
                 q = self._q[cls]
-                while (q and q[0].route == first.route
-                       and len(batch) < max_batch):
+                while q and _same(q[0]) and len(batch) < max_batch:
                     batch.append(self._pop_locked(cls))
                 if len(batch) >= max_batch:
                     break
-                if q and q[0].route != first.route:
-                    break  # a different route is waiting — serve it next
+                if q and not _same(q[0]):
+                    break  # different route/kind waiting — serve it next
                 if (cls != PRIORITY_CLASSES[0]
                         and self._q[PRIORITY_CLASSES[0]]):
                     break  # interactive arrived: stop padding batch work
@@ -483,12 +507,16 @@ class FleetRouter:
     def submit(self, route_name: str, genotypes: np.ndarray,
                priority: str = DEFAULT_PRIORITY,
                deadline_s: float | None = None,
-               trace: dict | None = None) -> Future:
+               trace: dict | None = None,
+               kind: str = "project", k: int = 0) -> Future:
         """Admit one single-sample query against ``route_name``;
-        returns a Future resolving to its (1, k) coordinates. Raises
-        :class:`UnknownRoute`, :class:`ServerOverloaded` (the class's
-        bounded queue is full), :class:`ServerClosed` after drain, or
-        ValueError on a malformed query / unknown priority class."""
+        returns a Future resolving to its (1, k) coordinates — or, for
+        ``kind="topk"``, to an ``(ids, sims)`` pair of (1, k) arrays
+        (exact nearest panel neighbors). Raises :class:`UnknownRoute`,
+        :class:`ServerOverloaded` (the class's bounded queue is full),
+        :class:`ServerClosed` after drain, or ValueError on a malformed
+        query / unknown priority class / a topk request against a route
+        without the capability."""
         if self._closed:
             raise ServerClosed("fleet is draining/closed")
         if priority not in PRIORITY_CLASSES:
@@ -497,6 +525,21 @@ class FleetRouter:
                 f"{' | '.join(PRIORITY_CLASSES)}"
             )
         route = self._route(route_name)
+        if kind not in ("project", "topk"):
+            raise ValueError(f"unknown request kind {kind!r}")
+        if kind == "topk":
+            if not route.topk:
+                raise ValueError(
+                    f"route {route_name!r} does not declare the 'topk' "
+                    "capability — add \"topk\": true to its manifest "
+                    "entry"
+                )
+            if not 1 <= int(k) <= 65536:
+                raise ValueError(
+                    f"topk request needs 1 <= k <= 65536, got {k!r}")
+            k = int(k)
+            route.bump("topk_requests")
+            telemetry.count("neighbors.requests")
         g = np.ascontiguousarray(genotypes, dtype=np.int8)
         if g.ndim == 2 and g.shape[0] == 1:
             g = g[0]
@@ -510,7 +553,12 @@ class FleetRouter:
         t0 = time.perf_counter()
         digest = None
         if self._cache.capacity:
-            digest = genotype_digest(g)
+            # topk results live beside project results in the same
+            # model-fingerprint namespace; the digest's namespace arg
+            # keys the KIND (and k), so the two can never answer each
+            # other — while unload_route still evicts both at once.
+            digest = genotype_digest(
+                g, namespace=f"topk:{k}" if kind == "topk" else "")
             hit = self._cache.get(digest, namespace=route.cache_ns)
             if hit is not None:
                 telemetry.count("serve.cache_hits")
@@ -524,7 +572,7 @@ class FleetRouter:
                     trace.setdefault("phases", {})["cache"] = \
                         time.perf_counter() - t0
                 fut: Future = Future()
-                fut.set_result(np.array(hit))
+                fut.set_result(_copy_result(hit))
                 return fut
             telemetry.count("serve.cache_misses")
         if deadline_s is None:
@@ -537,6 +585,8 @@ class FleetRouter:
             digest=digest,
             t_submit=t0,
             deadline=(t0 + deadline_s) if deadline_s else None,
+            kind=kind,
+            k=k,
             trace=trace,
         )
         with self._admission_lock:
@@ -564,6 +614,17 @@ class FleetRouter:
                            deadline_s=deadline_s,
                            trace=trace).result(timeout=timeout)
 
+    def topk(self, route_name: str, genotypes: np.ndarray, k: int,
+             timeout: float | None = None,
+             priority: str = DEFAULT_PRIORITY,
+             deadline_s: float | None = None,
+             trace: dict | None = None) -> tuple[np.ndarray, np.ndarray]:
+        """Synchronous topk convenience: ``(ids, sims)`` (1, k) arrays
+        of the query's exact nearest panel neighbors."""
+        return self.submit(route_name, genotypes, priority=priority,
+                           deadline_s=deadline_s, trace=trace,
+                           kind="topk", k=k).result(timeout=timeout)
+
     # -- introspection -----------------------------------------------------
 
     def queue_depths(self) -> dict[str, int]:
@@ -589,6 +650,12 @@ class FleetRouter:
             telemetry.gauge_set(
                 prefix + ".staged",
                 1.0 if self.pool.is_staged(name) else 0.0)
+            with route.tally_lock:
+                topk_reqs = route.tally["topk_requests"]
+            # The topk path is first-class autoscale input: a route
+            # whose load is mostly /neighbors must scale on it too.
+            telemetry.gauge_set(prefix + ".topk_requests",
+                                float(topk_reqs))
         telemetry.gauge_set("fleet.routes", float(len(self.routes)))
         telemetry.gauge_set("fleet.pool_bytes",
                             float(self.pool.resident_bytes()))
@@ -605,6 +672,7 @@ class FleetRouter:
             per_route[name] = {
                 **tally,
                 "staged": self.pool.is_staged(name),
+                "topk": route.topk,
                 "n_variants": route.n_variants,
                 "queue_depth": self._queues.route_depth(name),
                 "breaker": route.breaker.snapshot(),
@@ -742,6 +810,7 @@ class FleetRouter:
         t_device = time.perf_counter()
         cold = not self.pool.is_staged(route.name)
         stage_s = 0.0
+        kind = live[0].kind  # take_batch coalesces within one kind
         with telemetry.span("serve.device_step", cat="serve",
                             rows=len(live), route=route.name):
             try:
@@ -751,9 +820,14 @@ class FleetRouter:
                     t_compute = time.perf_counter()
                     if cold:
                         stage_s = t_compute - t_device
-                    coords = E.batch_coords(
-                        route.ctx, panel.blocks, g, self.max_batch,
-                        panel.n_variants)
+                    if kind == "topk":
+                        sims = E.batch_pair_sims(
+                            route.ctx, panel.blocks, g, self.max_batch,
+                            panel.n_variants)
+                    else:
+                        coords = E.batch_coords(
+                            route.ctx, panel.blocks, g, self.max_batch,
+                            panel.n_variants)
             except BaseException as e:  # incl. PanelUnavailable
                 telemetry.count("serve.errors", len(live))
                 route.bump("errors", len(live))
@@ -762,7 +836,18 @@ class FleetRouter:
                 return
         compute_s = time.perf_counter() - t_compute
         telemetry.observe("serve.batch_rows", len(live))
-        results = [(p, row[None, :]) for p, row in zip(live, coords)]
+        if kind == "topk":
+            # Per-row reduction on the host: each request may ask a
+            # different k, and the reduction is the SAME topk_rows the
+            # offline CLI runs — bit-identity by shared code.
+            from spark_examples_tpu.neighbors.engine import topk_rows
+
+            results = [
+                (p, topk_rows(sims[i:i + 1], p.k))
+                for i, p in enumerate(live)
+            ]
+        else:
+            results = [(p, row[None, :]) for p, row in zip(live, coords)]
         if self._cache.capacity:
             # Cache puts under the engine lock: unload_route (same
             # lock) may have raced batch completion, and entries put

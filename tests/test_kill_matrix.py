@@ -1,8 +1,8 @@
 """Kill-resume bit-identity matrix: {streaming gram, store compaction,
-serve hot-reload, streaming sketch solve} x 3 seeded kill points each,
-every run supervised (core/supervisor.py) so the kill -> restart ->
-resume cycle is the REAL production path, and every resumed output
-compared bit-for-bit against an uninterrupted run."""
+serve hot-reload, streaming sketch solve, minhash neighbors} x 3 seeded
+kill points each, every run supervised (core/supervisor.py) so the
+kill -> restart -> resume cycle is the REAL production path, and every
+resumed output compared bit-for-bit against an uninterrupted run."""
 
 import json
 import os
@@ -21,6 +21,7 @@ GRAM_KILL_POINTS = (1, 3, 5)     # ingest.block_read hit the kill lands on
 COMPACT_KILL_POINTS = (0, 1, 2)
 SERVE_KILL_POINTS = (0, 2, 4)    # serve.request hit
 SKETCH_KILL_POINTS = (1, 4, 9)   # pass 0 early, pass 0 late, pass 1
+NEIGHBORS_KILL_POINTS = (1, 4, 9)  # minhash early/late, exact-eval pass
 
 
 _CACHE_DIR = None  # session-scoped jax compile cache for the children
@@ -159,6 +160,58 @@ def test_sketch_kill_resume_bit_identical(packed_store, sketch_clean,
     assert "supervisor: attempt 0: crash: exit code 113" in p.stderr
     with open(out, "rb") as f:
         assert f.read() == sketch_clean
+
+
+# ------------------------------------------------- minhash neighbors job
+
+
+def _neighbors_cmd(store, out, ckpt):
+    return [sys.executable, "-m", "spark_examples_tpu", "neighbors",
+            "--source", "packed", "--path", store,
+            "--block-variants", "128", "--metric", "ibs",
+            "--minhash-hashes", "32", "--minhash-bands", "8",
+            "--neighbors-k", "5",
+            "--checkpoint-dir", ckpt, "--checkpoint-every-blocks", "2",
+            "--output-path", out]
+
+
+@pytest.fixture(scope="module")
+def neighbors_clean(packed_store, tmp_path_factory):
+    store, _g = packed_store
+    d = tmp_path_factory.mktemp("neighbors_clean")
+    out = str(d / "clean.topk")
+    p = subprocess.run(_neighbors_cmd(store, out, str(d / "ck")),
+                       env=_env(), capture_output=True, text=True,
+                       timeout=240)
+    assert p.returncode == 0, p.stderr[-2000:]
+    with open(out, "rb") as f:
+        return f.read()
+
+
+@pytest.mark.parametrize("kill_after", NEIGHBORS_KILL_POINTS)
+def test_neighbors_kill_resume_bit_identical(packed_store,
+                                             neighbors_clean, tmp_path,
+                                             kill_after):
+    """Supervised combined minhash+exact-eval neighbors run killed at
+    the Nth block read — early or late in the streamed signature pass
+    (which resumes from its solver:minhash checkpoint), or inside the
+    candidate-evaluation pass (deterministically re-run) — restarts
+    under the supervisor and writes a top-k file byte-identical to the
+    uninterrupted run's."""
+    store, _g = packed_store
+    out = str(tmp_path / "sim.topk")
+    env = _env(**{
+        faults.ENV_SPECS:
+            f"ingest.block_read:kill:after={kill_after}:max=1",
+    })
+    cmd = _neighbors_cmd(store, out, str(tmp_path / "ck")) + [
+        "--supervise"]
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "supervisor: attempt 0: crash: exit code 113" in p.stderr
+    with open(out, "rb") as f:
+        assert f.read() == neighbors_clean
 
 
 # ------------------------------------------------------ store compaction

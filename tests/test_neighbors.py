@@ -1,0 +1,527 @@
+"""Neighbor engine: MinHash signatures, LSH candidate filtering, exact
+sparse evaluation, the self-describing top-k/pairs output format, the
+fault-injection recovery boundary, and serve/CLI bit-identity of the
+query-vs-panel path."""
+
+import dataclasses
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.core import faults, telemetry
+from spark_examples_tpu.core.config import (
+    ComputeConfig,
+    IngestConfig,
+    JobConfig,
+    ServeConfig,
+)
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.neighbors import (
+    NeighborFormatError,
+    PairsResult,
+    TopKResult,
+    load_result,
+    save_result,
+)
+from spark_examples_tpu.neighbors import lsh
+from spark_examples_tpu.neighbors.engine import (
+    neighbors_job,
+    topk_from_pairs,
+    topk_rows,
+)
+from tests.conftest import random_genotypes
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure(dir=None)
+
+
+def _job(metric="ibs", **compute):
+    return JobConfig(
+        ingest=IngestConfig(block_variants=256),
+        compute=ComputeConfig(metric=metric, **compute),
+    )
+
+
+def family_cohort(rng, families=8, size=12, v=2048, carrier_rate=0.08,
+                  mutation_rate=0.03):
+    """Planted-relatives cohort: ``families`` founder carrier sets, each
+    cloned into ``size`` members with a few percent of entries
+    resampled. Every sample's true nearest neighbors are its family —
+    the structure an LSH filter must recover."""
+    blocks = []
+    for _ in range(families):
+        founder = (rng.random(v) < carrier_rate).astype(np.int8) * (
+            1 + (rng.random(v) < 0.3).astype(np.int8))
+        for _ in range(size):
+            g = founder.copy()
+            mut = rng.random(v) < mutation_rate
+            g[mut] = (rng.random(mut.sum()) < carrier_rate) * (
+                1 + (rng.random(mut.sum()) < 0.3)).astype(np.int8)
+            blocks.append(g)
+    return np.asarray(blocks, np.int8)
+
+
+# ------------------------------------------------ exact sparse evaluation
+
+
+def _dense_pair_sims(g, metric):
+    """Independent dense oracle: full N x N cross-statistics as int64
+    indicator matmuls (different evaluation order from the engine's
+    chunked per-pair einsum — integer arithmetic makes the comparison
+    exact), finalized through the same f64 PairSpec."""
+    from spark_examples_tpu import kernels
+    from spark_examples_tpu.ops import genotype
+
+    spec = kernels.get(metric).pair
+    ops = {
+        "c": (g >= 0).astype(np.int64),
+        "t1": (g >= 1).astype(np.int64),
+        "t2": (g >= 2).astype(np.int64),
+    }
+    ops["y"] = ops["t1"] + ops["t2"]
+    acc = {}
+    for s in spec.stats:
+        total = np.zeros((len(g), len(g)), np.int64)
+        for (left, right), w in genotype.CROSS_STATS[s]:
+            total += w * (ops[left] @ ops[right].T)
+        acc[s] = total
+    return np.asarray(spec.sim(acc), np.float64)
+
+
+@pytest.mark.parametrize("metric", ["ibs", "jaccard", "king"])
+def test_pair_sims_bitwise_equal_dense(rng, metric):
+    """The candidate-pair exact path (host int64 einsum over indicator
+    operands, PairSpec f64 finalize) must equal a dense exact oracle
+    bit for bit, and agree with the production dense similarity matrix
+    to its f32 output precision."""
+    from spark_examples_tpu.pipelines.jobs import similarity_matrix_job
+
+    g = random_genotypes(rng, 24, 700, missing_rate=0.1)
+    oracle = _dense_pair_sims(g, metric)
+    job = _job(metric=metric, minhash_hashes=32, minhash_bands=32,
+               neighbors_output="pairs")
+    res = neighbors_job(job, source=ArraySource(g))
+    assert isinstance(res, PairsResult)
+    assert len(res.pairs)  # bands=rows-of-1 proposes plenty
+    for (i, j), s in zip(res.pairs, res.sims):
+        assert float(s) == float(oracle[i, j]), (metric, i, j)
+    # ... and the oracle itself tracks the production dense route to
+    # the f32 precision that route emits at.
+    dense = similarity_matrix_job(
+        _job(metric=metric), source=ArraySource(g)).similarity
+    ii, jj = res.pairs[:, 0], res.pairs[:, 1]
+    np.testing.assert_allclose(oracle[ii, jj],
+                               np.asarray(dense, np.float64)[ii, jj],
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_topk_from_pairs_matches_dense_when_exhaustive(rng):
+    """With every pair a candidate, the sparse reduction must equal the
+    dense per-row top-k exactly (same ordering, same tie-breaks)."""
+    from spark_examples_tpu.pipelines.jobs import similarity_matrix_job
+
+    g = random_genotypes(rng, 18, 512, missing_rate=0.05)
+    dense = similarity_matrix_job(
+        _job(), source=ArraySource(g)).similarity.copy()
+    np.fill_diagonal(dense, -np.inf)  # top-k excludes self by design
+    want_ids, want_sims = topk_rows(dense, 5)
+    n = len(g)
+    pairs = np.array([(i, j) for i in range(n) for j in range(i + 1, n)],
+                     np.int64)
+    sims = np.array([dense[i, j] for i, j in pairs])
+    ids, vals = topk_from_pairs(pairs, sims, n, 5)
+    np.testing.assert_array_equal(ids, want_ids)
+    np.testing.assert_array_equal(vals, want_sims)
+
+
+def test_recall_oracle_planted_relatives(rng):
+    """The acceptance contract in miniature: on a planted-relatives
+    cohort the LSH filter must evaluate a small fraction of all pairs
+    yet recover >= 0.95 of the dense exact top-k."""
+    from spark_examples_tpu.pipelines.jobs import similarity_matrix_job
+
+    g = family_cohort(rng)
+    n, k = len(g), 10
+    job = _job(metric="ibs", minhash_hashes=64, minhash_bands=16,
+               neighbors_k=k)
+    res = neighbors_job(job, source=ArraySource(g))
+    assert isinstance(res, TopKResult)
+
+    dense = similarity_matrix_job(
+        _job(), source=ArraySource(g)).similarity.copy()
+    np.fill_diagonal(dense, -np.inf)
+    dense_ids, _ = topk_rows(dense, k)
+
+    hits = sum(
+        len(set(res.ids[i][res.ids[i] >= 0].tolist())
+            & set(dense_ids[i].tolist()))
+        for i in range(n)
+    )
+    recall = hits / float(n * k)
+    evaluated = telemetry.counter_value("neighbors.candidate_pairs")
+    frac_evaluated = evaluated / (n * (n - 1) / 2)
+    assert recall >= 0.95, (recall, frac_evaluated)
+    assert frac_evaluated <= 0.5, frac_evaluated
+    # Telemetry contract: the filter fraction gauge and candidate/
+    # evaluated counters were published.
+    snap = telemetry.metrics_snapshot()
+    assert snap["gauges"]["neighbors.filter_frac"]["last"] == (
+        pytest.approx(1.0 - frac_evaluated))
+    assert telemetry.counter_value("neighbors.evaluated_pairs") == (
+        evaluated)
+
+
+def test_bucket_cap_bounds_candidates_and_counts_overflow():
+    """A degenerate cohort (everyone identical => one bucket) must
+    truncate at the cap and count what it dropped instead of going
+    quadratic."""
+    sig = np.zeros((50, 16), np.uint32)  # all-identical signatures
+    pairs, n_overflow, _nb = lsh.candidate_pairs(sig, bands=4,
+                                                 bucket_cap=10)
+    # 10-member buckets -> at most C(10,2) distinct pairs
+    assert len(pairs) <= 45
+    assert n_overflow == 4 * 40  # 40 dropped per band
+    assert lsh.filter_fraction(len(pairs), 50) > 0.9
+
+
+def test_minhash_bands_must_divide_hashes():
+    with pytest.raises(ValueError, match="--minhash-bands"):
+        ComputeConfig(minhash_hashes=10, minhash_bands=3)
+    with pytest.raises(ValueError, match="--neighbors-output"):
+        ComputeConfig(neighbors_output="csv")
+    with pytest.raises(ValueError, match="--neighbors-k"):
+        ComputeConfig(neighbors_k=0)
+    with pytest.raises(ValueError, match="--minhash-bucket-cap"):
+        ComputeConfig(minhash_bucket_cap=0)
+
+
+def test_metric_without_pair_finalize_is_rejected(rng):
+    g = random_genotypes(rng, 8, 256)
+    with pytest.raises(ValueError, match="pairwise finalize"):
+        neighbors_job(_job(metric="braycurtis"), source=ArraySource(g))
+
+
+def test_signatures_deterministic_across_block_partitions(rng):
+    """MinHash signatures hash GLOBAL variant indices, so the block
+    partition cannot change them — the property that makes checkpoint
+    resume bit-identical by construction."""
+    from spark_examples_tpu.core.profiling import PhaseTimer
+    from spark_examples_tpu.neighbors.engine import minhash_signatures
+
+    g = random_genotypes(rng, 10, 640, missing_rate=0.1)
+    sigs = []
+    for bv in (64, 256, 640):
+        job = JobConfig(ingest=IngestConfig(block_variants=bv),
+                        compute=ComputeConfig(minhash_hashes=32))
+        sig, n_variants = minhash_signatures(job, ArraySource(g),
+                                             PhaseTimer())
+        assert n_variants == 640
+        sigs.append(sig)
+    np.testing.assert_array_equal(sigs[0], sigs[1])
+    np.testing.assert_array_equal(sigs[0], sigs[2])
+
+
+# ------------------------------------------------------- output format
+
+
+def _topk_result():
+    return TopKResult(
+        ids=np.array([[1, 2], [0, -1]], np.int32),
+        sims=np.array([[0.9, 0.5], [0.9, 0.0]], np.float64),
+        sample_ids=("a", "b"), metric="ibs", k=2, n_variants=77,
+    )
+
+
+def test_topk_roundtrip(tmp_path):
+    path = str(tmp_path / "r.topk")
+    want = _topk_result()
+    save_result(path, want)
+    got = load_result(path)
+    assert isinstance(got, TopKResult)
+    np.testing.assert_array_equal(got.ids, want.ids)
+    np.testing.assert_array_equal(got.sims, want.sims)
+    assert got.sample_ids == want.sample_ids
+    assert (got.metric, got.k, got.n_variants) == ("ibs", 2, 77)
+
+
+def test_pairs_roundtrip(tmp_path):
+    path = str(tmp_path / "r.pairs")
+    want = PairsResult(
+        pairs=np.array([[0, 1], [1, 2]], np.int64),
+        sims=np.array([0.25, 0.75]),
+        sample_ids=("a", "b", "c"), metric="jaccard", n_variants=5,
+    )
+    save_result(path, want)
+    got = load_result(path, expect_kind="pairs")
+    assert isinstance(got, PairsResult)
+    np.testing.assert_array_equal(got.pairs, want.pairs)
+    np.testing.assert_array_equal(got.sims, want.sims)
+
+
+def test_save_is_atomic_and_deterministic(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    save_result(a, _topk_result())
+    save_result(b, _topk_result())
+    with open(a, "rb") as fa, open(b, "rb") as fb:
+        assert fa.read() == fb.read()  # no timestamps, no tempfile names
+    assert [p.name for p in tmp_path.iterdir()] != []  # no tmp litter
+    assert all(not p.name.startswith("tmp")
+               for p in tmp_path.iterdir())
+
+
+def test_format_error_ladder(tmp_path):
+    path = str(tmp_path / "r.topk")
+    save_result(path, _topk_result())
+    with open(path, "rb") as f:
+        header, payload = f.read().split(b"\n", 1)
+    doc = json.loads(header)
+
+    def write(doc2, body=payload, name="bad"):
+        p = str(tmp_path / name)
+        with open(p, "wb") as f:
+            f.write(json.dumps(doc2).encode() + b"\n" + body)
+        return p
+
+    with pytest.raises(NeighborFormatError, match="cannot read"):
+        load_result(str(tmp_path / "missing"))
+    with pytest.raises(NeighborFormatError, match="format tag"):
+        load_result(write(dict(doc, format="something-else")))
+    with pytest.raises(NeighborFormatError, match="schema_version"):
+        load_result(write(dict(doc, schema_version=99)))
+    with pytest.raises(NeighborFormatError, match="missing field"):
+        load_result(write({k: v for k, v in doc.items() if k != "k"}))
+    with pytest.raises(NeighborFormatError, match="unknown neighbors"):
+        load_result(write(dict(doc, kind="heap")))
+    with pytest.raises(NeighborFormatError,
+                       match="--neighbors-output pairs"):
+        load_result(path, expect_kind="pairs")
+    bad_arrays = [dict(a, dtype="<f4") for a in doc["arrays"]]
+    with pytest.raises(NeighborFormatError, match="schema drift"):
+        load_result(write(dict(doc, arrays=bad_arrays)))
+    with pytest.raises(NeighborFormatError, match="truncated"):
+        load_result(write(doc, body=payload[:-4]))
+    with pytest.raises(NeighborFormatError, match="trailing"):
+        load_result(write(doc, body=payload + b"xx"))
+
+
+# ------------------------------------------ fault injection + recovery
+
+
+def test_neighbors_candidates_io_error_recovers_bit_identically(rng):
+    """An injected io_error at the ``neighbors.candidates`` site must
+    surface the retry warning and still produce output byte-identical
+    to a clean run (the block is recomputed wholesale, never partially
+    accumulated)."""
+    g = random_genotypes(rng, 20, 768, missing_rate=0.1)
+    job = _job(minhash_hashes=32, minhash_bands=16, neighbors_k=5)
+    clean = neighbors_job(job, source=ArraySource(g))
+    with faults.armed(["neighbors.candidates:io_error:after=1:max=2"]):
+        with pytest.warns(RuntimeWarning, match="recomputing"):
+            faulted = neighbors_job(job, source=ArraySource(g))
+    np.testing.assert_array_equal(faulted.ids, clean.ids)
+    np.testing.assert_array_equal(faulted.sims, clean.sims)
+
+
+def test_neighbors_candidates_io_error_exhausts_budget(rng):
+    """Past the retry budget the io_error propagates — fail loudly,
+    never emit partial similarities."""
+    g = random_genotypes(rng, 12, 512)
+    job = JobConfig(
+        ingest=IngestConfig(block_variants=256, io_retries=1),
+        compute=ComputeConfig(metric="ibs", minhash_hashes=32,
+                              minhash_bands=16),
+    )
+    with faults.armed(["neighbors.candidates:io_error:max=99"]):
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(IOError):
+                neighbors_job(job, source=ArraySource(g))
+
+
+# ------------------------------------------------ serving (fleet topk)
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """A store-backed pcoa model fleet with one topk-capable route,
+    plus the raw genotypes."""
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+    from spark_examples_tpu.serve.fleet import FleetManifest, build_fleet
+    from spark_examples_tpu.store.writer import compact
+
+    rng = np.random.default_rng(77)
+    g = random_genotypes(rng, 14, 512, missing_rate=0.05)
+    d = tmp_path_factory.mktemp("nbserve")
+    store = str(d / "store")
+    compact(store, ArraySource(g), chunk_variants=128)
+    model = str(d / "m.npz")
+    pcoa_job(JobConfig(
+        ingest=IngestConfig(block_variants=128),
+        compute=ComputeConfig(metric="ibs", num_pc=3),
+        model_path=model,
+    ), source=ArraySource(g))
+    manifest = FleetManifest.parse({
+        "budget_mb": 4.0,
+        "routes": [{"name": "r", "model": model,
+                    "source": f"store:{store}", "topk": True}],
+    })
+    fleet = build_fleet(manifest, ServeConfig(),
+                        ingest_defaults=IngestConfig(block_variants=128))
+    fleet.start()
+    yield fleet, g, model, store
+    fleet.close()
+
+
+def test_served_topk_bit_identical_to_offline(served):
+    """The /neighbors serving path and the offline query-vs-panel
+    engine answer from the same padded-batch kernel and the same top-k
+    reduction — assert the bit-identity, including immediately after
+    the route's panel is evicted and re-staged."""
+    from spark_examples_tpu.pipelines import project as P
+    from spark_examples_tpu.serve import engine as E
+
+    fleet, g, model, _store = served
+    rng = np.random.default_rng(5)
+    queries = random_genotypes(rng, 3, g.shape[1], missing_rate=0.05)
+
+    ctx = E.ModelContext(P.load_model(model))
+    blocks, n_variants, _nb = E.stage_blocks(ArraySource(g), 128)
+    want_ids, want_sims = E.batch_topk(ctx, blocks, queries, 8,
+                                       n_variants, 4)
+
+    got = [fleet.topk("r", q.copy(), k=4) for q in queries]
+    for i, (ids, sims) in enumerate(got):
+        np.testing.assert_array_equal(ids[0], want_ids[i])
+        np.testing.assert_array_equal(sims[0], want_sims[i])
+
+    fleet.pool.remove("r")  # evict; next request must re-stage
+    ids2, sims2 = fleet.topk("r", queries[0].copy(), k=4)
+    np.testing.assert_array_equal(ids2[0], want_ids[0])
+    np.testing.assert_array_equal(sims2[0], want_sims[0])
+
+
+def test_neighbors_http_endpoint_and_stats(served):
+    from spark_examples_tpu.serve.http import start_fleet_http_server
+
+    fleet, g, _model, _store = served
+    h = start_fleet_http_server(fleet)
+    try:
+        body = json.dumps({"genotypes": g[2].tolist(), "k": 3}).encode()
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{h.port}/neighbors/r", data=body,
+            headers={"Content-Type": "application/json"}))
+        doc = json.loads(r.read())
+        assert doc["k"] == 3
+        assert len(doc["neighbor_ids"][0]) == 3
+        assert doc["neighbor_indices"][0] == [
+            list(fleet.routes["r"].ctx.model.sample_ids).index(s)
+            for s in doc["neighbor_ids"][0]]
+        direct = fleet.topk("r", g[2].copy(), k=3)
+        assert doc["neighbor_indices"] == [direct[0][0].tolist()]
+        assert doc["similarities"] == [direct[1][0].tolist()]
+        # Satellite: /stats and the autoscale gauges carry the topk path
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{h.port}/stats").read())
+        assert stats["routes"]["r"]["topk"] is True
+        assert stats["routes"]["r"]["topk_requests"] >= 2
+        fleet.publish_autoscale()
+        snap = telemetry.metrics_snapshot()
+        assert "fleet.route.r.topk_requests" in snap["gauges"]
+        assert telemetry.counter_value("neighbors.requests") >= 2
+    finally:
+        h.shutdown()
+
+
+def test_topk_capability_gated(served):
+    """A route without ``"topk": true`` refuses neighbor queries, and a
+    manifest declaring topk on a model that cannot honor it dies at
+    build time as FleetFormatError."""
+    from spark_examples_tpu.pipelines.jobs import variants_pca_job
+    from spark_examples_tpu.serve.fleet import (
+        FleetFormatError,
+        FleetManifest,
+        build_fleet,
+    )
+    from spark_examples_tpu.store.writer import compact
+
+    fleet, g, model, store = served
+    no_cap = FleetManifest.parse({
+        "budget_mb": 4.0,
+        "routes": [{"name": "plain", "model": model,
+                    "source": f"store:{store}"}],
+    })
+    plain = build_fleet(no_cap, ServeConfig(),
+                        ingest_defaults=IngestConfig(block_variants=128))
+    plain.start()
+    try:
+        with pytest.raises(ValueError, match="topk"):
+            plain.topk("plain", g[0].copy(), k=3)
+        plain.project("plain", g[0].copy())  # projection still fine
+    finally:
+        plain.close()
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        pca_model = f"{d}/pca.npz"
+        pca_store = f"{d}/store"
+        compact(pca_store, ArraySource(np.abs(g)), chunk_variants=128)
+        variants_pca_job(JobConfig(
+            ingest=IngestConfig(block_variants=128),
+            compute=ComputeConfig(metric="shared-alt", num_pc=3),
+            model_path=pca_model,
+        ), source=ArraySource(np.abs(g)))
+        bad = FleetManifest.parse({
+            "budget_mb": 4.0,
+            "routes": [{"name": "pca", "model": pca_model,
+                        "source": f"store:{pca_store}", "topk": True}],
+        })
+        with pytest.raises(FleetFormatError, match="cannot honor"):
+            build_fleet(bad, ServeConfig(),
+                        ingest_defaults=IngestConfig(block_variants=128))
+
+
+def test_manifest_topk_field_validated():
+    from spark_examples_tpu.serve.fleet import (
+        FleetFormatError,
+        FleetManifest,
+    )
+
+    with pytest.raises(FleetFormatError, match="topk"):
+        FleetManifest.parse({"routes": [
+            {"name": "r", "model": "m.npz", "source": "store:/x",
+             "topk": "yes"}]})
+
+
+# --------------------------------------------------------------- CLI
+
+
+def test_cli_cohort_mode_writes_loadable_topk(rng, tmp_path, capsys):
+    from spark_examples_tpu.cli.main import main
+
+    g = np.abs(random_genotypes(rng, 16, 512, missing_rate=0.1))
+    from spark_examples_tpu.ingest.packed import save_packed
+    store = str(tmp_path / "packed")
+    save_packed(store, g, bits=2)
+    out = str(tmp_path / "out.topk")
+    rc = main(["neighbors", "--source", "packed", "--path", store,
+               "--block-variants", "128", "--metric", "ibs",
+               "--minhash-hashes", "32", "--minhash-bands", "8",
+               "--neighbors-k", "4", "--output-path", out])
+    assert rc == 0
+    res = load_result(out, expect_kind="topk")
+    assert res.k == 4 and len(res.sample_ids) == 16
+    assert "top-4 for 16 samples" in capsys.readouterr().out
+
+
+def test_cli_rejects_bad_knobs(tmp_path):
+    from spark_examples_tpu.cli.main import main
+
+    with pytest.raises(SystemExit):
+        main(["neighbors", "--source", "synthetic",
+              "--minhash-hashes", "10", "--minhash-bands", "3"])

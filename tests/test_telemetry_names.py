@@ -322,6 +322,11 @@ def test_core_names_present():
         "slo.ok",
         "slo.*",
         "controller.ledger_rotations",
+        "neighbors.candidate_pairs",
+        "neighbors.filter_frac",
+        "neighbors.bucket_overflows",
+        "neighbors.evaluated_pairs",
+        "neighbors.requests",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution

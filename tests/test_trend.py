@@ -92,6 +92,15 @@ def test_metric_directions_resolve_sensibly():
     # SLO trip is a must-hold boolean via the *_ok suffix.
     assert d("trace_overhead_frac") == trend.LOWER_IS_BETTER
     assert d("slo_fast_burn_ok") == trend.BOOL_MUST_HOLD
+    # Neighbor engine (bench --neighbors): recall and the avoided-pair
+    # fraction go UP, the served p99 goes DOWN, the sparse-vs-dense
+    # wall ratio is a speedup (up), and the composite acceptance gate
+    # (<= 10% evaluated, recall >= 0.95, served == offline) must hold.
+    assert d("neighbors_recall_at_k") == trend.HIGHER_IS_BETTER
+    assert d("neighbors_filter_frac") == trend.HIGHER_IS_BETTER
+    assert d("neighbors_p99_ms") == trend.LOWER_IS_BETTER
+    assert d("neighbors_sparse_speedup_vs_dense") == trend.HIGHER_IS_BETTER
+    assert d("neighbors_ok") == trend.BOOL_MUST_HOLD
 
 
 # ------------------------------------------------------------------ the band

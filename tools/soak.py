@@ -106,6 +106,12 @@ SCENARIOS: tuple = (
      dict(after=(0, 2), max=(1, 2), delay=0.01)),
     ("gram", "checkpoint.tile_write", "truncate",
      dict(after=(0, 7), max=(1, 1), keep=8)),
+    # Neighbor rounds: the combined minhash+exact-eval job over the
+    # store source; an io_error at the candidate-evaluation site is
+    # recomputed wholesale inside the retry boundary, so the sparse
+    # top-k must come out bit-identical to the clean baseline.
+    ("neighbors", "neighbors.candidates", "io_error",
+     dict(after=(0, 6), max=(1, 2))),
     ("serve", "serve.request", "io_error", dict(after=(0, 5), max=(1, 1))),
     ("serve", "serve.request", "delay", dict(after=(0, 5), max=(1, 2),
                                              delay=0.02)),
@@ -270,6 +276,10 @@ class _Fixture:
         self.baseline_sim = self._gram_job(None).similarity
         self.baseline_sim_dense = self._gram_job(None,
                                                  metric="dot").similarity
+        # Clean neighbors baseline (minhash + LSH + exact sparse eval
+        # over the same store transport the faulted rounds run).
+        nb = self._neighbors_job()
+        self.baseline_neighbors = (nb.ids.copy(), nb.sims.copy())
         # Serve fixture: model fit over the same panel + warmed engine.
         from spark_examples_tpu.pipelines.jobs import pcoa_job
         from spark_examples_tpu.serve import ProjectionEngine
@@ -383,6 +393,25 @@ class _Fixture:
         finally:
             self._close_source(src)
 
+    def _neighbors_job(self):
+        from spark_examples_tpu.neighbors.engine import neighbors_job
+
+        job = JobConfig(
+            ingest=IngestConfig(
+                source="store", path=self.store_dir,
+                block_variants=self.cfg.block_variants,
+                io_retries=3, io_retry_backoff_s=0.001,
+                readahead_chunks=2, store_cache_mb=4,
+            ),
+            compute=ComputeConfig(metric="ibs", minhash_hashes=32,
+                                  minhash_bands=8, neighbors_k=5),
+        )
+        src = runner.build_source(job.ingest)
+        try:
+            return neighbors_job(job, source=src)
+        finally:
+            self._close_source(src)
+
     def store_consistent(self) -> str | None:
         """Post-round store invariant: quarantine ledger empty and
         every chunk file byte-verifiable. A reason string on violation."""
@@ -453,6 +482,26 @@ def _run_gram_round(fx: _Fixture, i: int, spec: str,
     reason = _snapshots_readable(tel)
     if reason:
         problems.append(reason)
+    return problems
+
+
+def _run_neighbors_round(fx: _Fixture, spec: str,
+                         round_seed: int) -> list[str]:
+    """One in-process neighbors round under `spec`: the injected
+    io_error in the candidate-evaluation loop is retried by recomputing
+    the block wholesale, so the sparse top-k (ids AND similarities)
+    must equal the clean baseline exactly."""
+    problems: list[str] = []
+    with faults.armed([spec], seed=round_seed):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            res = fx._neighbors_job()
+    ids0, sims0 = fx.baseline_neighbors
+    if not np.array_equal(res.ids, ids0):
+        problems.append("neighbor ids differ from clean baseline")
+    if not np.array_equal(res.sims, sims0):
+        problems.append("neighbor similarities differ from clean "
+                        "baseline")
     return problems
 
 
@@ -844,6 +893,8 @@ def run_soak(cfg: SoakConfig) -> SoakReport:
             elif jobkind == "gram-dense":
                 problems = _run_gram_round(fx, i, spec, round_seed,
                                            metric="dot")
+            elif jobkind == "neighbors":
+                problems = _run_neighbors_round(fx, spec, round_seed)
             elif jobkind == "serve":
                 problems = _run_serve_round(fx, spec, round_seed)
             elif jobkind == "fleet":

@@ -104,6 +104,14 @@ _EXPLICIT: dict[str, int | None] = {
     # one must go DOWN (the flight recorder budget: <= 2% is the PR
     # gate). slo_fast_burn_ok rides the *_ok must-hold gate.
     "trace_overhead_frac": LOWER_IS_BETTER,
+    # Neighbor engine (bench --neighbors): recall@k has no suffix rule
+    # and must go UP (lost relatives are the failure mode), as must
+    # the fraction of pairs the LSH filter avoided evaluating — the
+    # "_frac" here is a gain, unlike the stall/overhead fractions.
+    # neighbors_sparse_speedup_vs_dense rides the "_vs_" rule,
+    # neighbors_p99_ms the "_ms" suffix, neighbors_ok the *_ok gate.
+    "neighbors_recall_at_k": HIGHER_IS_BETTER,
+    "neighbors_filter_frac": HIGHER_IS_BETTER,
 }
 
 # (match kind, token, direction) — first hit wins, checked in order:
