@@ -721,6 +721,152 @@ def bench_sketch() -> dict:
     return out
 
 
+def bench_sketch_serve() -> dict:
+    """``--sketch-serve``: the servable-sketch-model path end to end at
+    the N = 10k sketch scale, with every dense N x N allocation site
+    rigged to explode (the same no-N x N harness as the PR-7 solver
+    test) for the WHOLE refit -> save -> serve chain:
+
+    - refit: ``--solver corrected`` ibs PCoA (dual sketch: centering
+      stats + scale diagonal folded into the same streamed passes) with
+      ``--save-model`` -> a FactorizedModel artifact, rung/rank/seed in
+      its fingerprint.
+    - serve: one fleet route over the store-compacted 10k panel under a
+      pool budget of 0.4 panels, so EVERY request streams the panel as
+      >= 2 budget-sized shards (``fleet.shard_stages``) with transient-
+      only pool charges.
+    - reported: ``stage_s`` (first request wall — the full shard-
+      streamed cold serve), ``served_p99_ms`` over the steady sequence
+      (every request re-streams; there is no warm tier to hide behind),
+      ``panel_over_budget_x`` (panel bytes / budget), and ``ok`` —
+      served coordinates bit-identical to the offline single-query
+      ``project`` path, the corrected rung visible in the loaded
+      model's fingerprint fields, >= 2 shards observed, and zero
+      transient bytes left charged."""
+    import tempfile
+
+    from spark_examples_tpu.core import telemetry
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig, ServeConfig,
+    )
+    from spark_examples_tpu.ingest.source import ArraySource
+    from spark_examples_tpu.ingest.synthetic import SyntheticSource
+    from spark_examples_tpu.ops import distances, gram
+    from spark_examples_tpu.parallel import gram_sharded
+    from spark_examples_tpu.pipelines import runner
+    from spark_examples_tpu.pipelines.jobs import pcoa_job
+    from spark_examples_tpu.pipelines.project import (
+        load_model, pcoa_project_job,
+    )
+    from spark_examples_tpu.serve import FleetManifest, build_fleet
+    from spark_examples_tpu.store.writer import compact
+
+    N_SV, V_SV = SKETCH_SERVE_N, SKETCH_SERVE_V
+    RANK, ITERS, SEED = 96, 4, 11
+    REQUESTS = 12
+    panel_bytes = N_SV * V_SV
+    out: dict = {"n": N_SV, "n_variants": V_SV, "rank": RANK,
+                 "iters": ITERS}
+
+    os.makedirs(CACHE, exist_ok=True)
+    workdir = tempfile.mkdtemp(prefix="bench_sketch_serve_", dir=CACHE)
+    model = os.path.join(workdir, "model.npz")
+
+    def boom(*a, **k):
+        raise AssertionError("N x N allocated on the sketch-serve path")
+
+    rigged = ((gram_sharded, "init_sharded"), (gram, "init"),
+              (distances, "finalize"))
+    saved = [(m, n, getattr(m, n)) for m, n in rigged]
+    for m, n, _ in saved:
+        setattr(m, n, boom)
+    try:
+        t0 = time.perf_counter()
+        pcoa_job(JobConfig(
+            ingest=IngestConfig(source="synthetic", n_samples=N_SV,
+                                n_variants=V_SV, block_variants=BLOCK,
+                                seed=SEED),
+            compute=ComputeConfig(metric="ibs", num_pc=K,
+                                  solver="corrected", sketch_rank=RANK,
+                                  sketch_iters=ITERS),
+            model_path=model,
+        ))
+        out["fit_save_s"] = round(time.perf_counter() - t0, 3)
+        mdl = load_model(model)
+        rung_in_fingerprint = (mdl.kind == "factorized"
+                               and mdl.solver == "corrected"
+                               and mdl.rank == RANK)
+        out["model_digest"] = mdl.digest()
+
+        compact(os.path.join(workdir, "store"),
+                SyntheticSource(n_samples=N_SV, n_variants=V_SV,
+                                seed=SEED),
+                chunk_variants=BLOCK)
+        budget = int(panel_bytes * 0.4)
+        manifest = FleetManifest.parse({
+            "budget_mb": budget / 1e6,
+            "routes": [{"name": "sk", "model": model,
+                        "source": f"store:{os.path.join(workdir, 'store')}"}],
+        })
+        fleet = build_fleet(
+            manifest, ServeConfig(cache_entries=0, max_linger_ms=1.0),
+            ingest_defaults=IngestConfig(block_variants=BLOCK),
+        ).start()
+        stages0 = telemetry.counter_value("fleet.shard_stages")
+        try:
+            q_rng = np.random.default_rng(5)
+            queries = np.where(
+                q_rng.random((REQUESTS, V_SV)) < 0.02, -1,
+                q_rng.integers(0, 3, (REQUESTS, V_SV))).astype(np.int8)
+            lats = []
+            served = []
+            for q in queries:
+                t0 = time.perf_counter()
+                served.append(fleet.project("sk", q, timeout=3600.0))
+                lats.append(time.perf_counter() - t0)
+            out["stage_s"] = round(lats[0], 3)
+            out["served_p99_ms"] = round(
+                float(np.percentile(
+                    np.asarray(lats[1:]) * 1e3, 99)), 1)
+            shards = int(telemetry.counter_value("fleet.shard_stages")
+                         - stages0)
+            out["shard_stages"] = shards
+            out["panel_over_budget_x"] = round(panel_bytes / budget, 2)
+            # Offline ground truth at the single-query anchor, over the
+            # same store transport (partition-invariant accumulation).
+            identical = True
+            for q, got in zip(queries[:2], served[:2]):
+                ref = runner.build_source(IngestConfig(
+                    source="store",
+                    path=os.path.join(workdir, "store"),
+                    block_variants=BLOCK))
+                offline = pcoa_project_job(
+                    JobConfig(ingest=IngestConfig(
+                        block_variants=BLOCK)),
+                    model_path=model,
+                    source_new=ArraySource(q[None, :]),
+                    source_ref=ref,
+                ).coords
+                identical = identical and bool(
+                    np.array_equal(got, offline))
+            transient_clean = (
+                fleet.pool.stats()["transient_bytes"] == 0)
+            clean = fleet.drain(timeout=300.0)
+        finally:
+            fleet.close()
+    finally:
+        for m, n, orig in saved:
+            setattr(m, n, orig)
+    out["ok"] = bool(identical and rung_in_fingerprint and clean
+                     and shards >= 2 * REQUESTS and transient_clean)
+    log(f"sketch-serve {N_SV}: fit+save {out['fit_save_s']}s, first serve "
+        f"{out['stage_s']}s, p99 {out['served_p99_ms']}ms, "
+        f"{shards} shard stages over {REQUESTS} requests "
+        f"({out['panel_over_budget_x']}x over budget), "
+        f"identical={identical}")
+    return out
+
+
 def bench_tile_rate() -> dict:
     """Config 4: per-chip gram rate at the 76k tile2d workload.
 
@@ -1260,6 +1406,12 @@ def bench_serve(store: str) -> dict:
 
 FLEET_SAMPLES = 256    # per-route fleet panel cohort
 FLEET_VARIANTS = 8_192
+
+# --sketch-serve scale: the N where dense N x N no longer fits (the
+# sketch ladder's reason to exist) — the whole refit -> save -> serve
+# chain runs with every N x N site rigged to explode.
+SKETCH_SERVE_N = 10_000
+SKETCH_SERVE_V = 65_536
 
 
 def bench_fleet() -> dict:
@@ -2364,6 +2516,38 @@ def main() -> None:
         print(json.dumps({**headline, "configs": {"neighbors": nb}}))
         print(json.dumps(headline))
         if not headline["neighbors_ok"]:
+            raise SystemExit(1)
+        return
+
+    if "--sketch-serve" in sys.argv:
+        # The standalone servable-sketch-model row: refit -> save ->
+        # shard-staged serve with dense N x N rigged to explode end to
+        # end; record backend-tagged, exit nonzero unless the
+        # acceptance gate holds — same stdout contract as
+        # --multichip-only.
+        sv = bench_sketch_serve()
+        headline = {
+            "sketch_serve_stage_s": sv["stage_s"],
+            "sketch_serve_p99_ms": sv["served_p99_ms"],
+            "sketch_serve_panel_over_budget_x": sv[
+                "panel_over_budget_x"],
+            "sketch_serve_ok": sv["ok"],
+        }
+        from tools import trend as trend_mod
+
+        history_path = os.path.join(REPO, trend_mod.HISTORY_FILE)
+        try:
+            trend_mod.append_history(history_path, headline, run_meta={
+                "argv": sys.argv[1:],
+                "backend": jax.default_backend(),
+                "device": str(jax.devices()[0].device_kind),
+            })
+        except OSError as e:
+            log(f"{trend_mod.HISTORY_FILE} not appended ({e})")
+        print(json.dumps({**headline,
+                          "configs": {"sketch_serve": sv}}))
+        print(json.dumps(headline))
+        if not headline["sketch_serve_ok"]:
             raise SystemExit(1)
         return
 

@@ -642,10 +642,28 @@ class JobConfig:
     compute: ComputeConfig = field(default_factory=ComputeConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     output_path: str | None = None
-    # pcoa only: persist the fitted embedding (eigenpairs + centering
+    # pcoa/pca: persist the fitted embedding (eigenpairs + centering
     # statistics) so `project` can later place NEW samples into this
-    # coordinate space without refitting (pipelines/project.py).
+    # coordinate space without refitting (pipelines/project.py). Sketch
+    # ladder rungs save the factorized artifact (models/factorized.py)
+    # when the metric has a factorized projection path — validated
+    # below at config time.
     model_path: str | None = None
+
+    def __post_init__(self):
+        # --save-model x --solver x --metric is a cross-dataclass
+        # combination, so it validates here (the only config level that
+        # sees both sides), with the flags named per the IngestConfig
+        # convention. Only combinations invalid for EVERY job kind are
+        # rejected — a JobConfig serves pcoa, pca, and similarity jobs
+        # alike, and kind-specific rows (e.g. a pcoa fit of a
+        # pca-family metric) resolve in the run-time driver gate.
+        if self.model_path and self.compute.solver != "exact":
+            try:
+                kernels.check_factorized_savable(self.compute.metric,
+                                                 self.compute.solver)
+            except ValueError as e:
+                raise ValueError(f"bad job config: {e}") from None
 
     def replace(self, **kw) -> "JobConfig":
         return dataclasses.replace(self, **kw)

@@ -387,6 +387,15 @@ NAMES: dict[str, tuple[str, str]] = {
         "staged route under the configured budget (the panel re-stages "
         "on demand through the store — nothing is lost, only warmth)",
     ),
+    "fleet.shard_stages": (
+        "counter",
+        "shards staged while serving a panel that exceeds the pool "
+        "budget (serve/router.py _sharded_blocks): each is one "
+        "budget-sized slice of the panel streamed from the store, "
+        "charged transiently against the pool, and dropped after its "
+        "blocks are consumed — the request count times the shard "
+        "count, since over-budget panels cannot be kept warm",
+    ),
     "fleet.cache_namespace_evictions": (
         "counter",
         "result-cache entries reclaimed because their route was "
@@ -570,6 +579,14 @@ NAMES: dict[str, tuple[str, str]] = {
         "resident / budget of the fleet warm pool (1.0 = at budget; "
         "sustained ~1.0 with climbing fleet.restage_total means the "
         "working set does not fit and cold starts are being paid)",
+    ),
+    "fleet.panel_over_budget_x": (
+        "gauge",
+        "panel bytes / pool budget of the last shard-staged route "
+        "served (>1.0 by construction): how many budgets' worth of "
+        "panel each request streams through — ceil of it is the shard "
+        "count per request; raise --fleet-budget-mb above it to serve "
+        "the route warm instead",
     ),
     "fleet.route.*": (
         "gauge",

@@ -12,9 +12,11 @@ from spark_examples_tpu.kernels.base import (  # noqa: F401
     Kernel,
     PairSpec,
     all_kernels,
+    check_factorized_savable,
     check_sketchable,
     dual_sketch_names,
     factor_sketch_names,
+    factorized_savable_names,
     get,
     gram_names,
     maybe_get,

@@ -72,6 +72,13 @@ class FactorSketch:
 
     features: Callable
     uses_nvar: bool = False
+    # The factor IS the PCA driver's similarity (S = A A^T with no
+    # denominator, the shared-alt convention): a sketch-rung fit of it
+    # can be saved as a factorized PCA model and projected with the
+    # exact route's centering formula. Factor metrics without this flag
+    # (grm, dot, euclidean) have no cross-projection machinery at all,
+    # so their sketch fits stay unservable.
+    pca_family: bool = False
 
 
 @dataclass(frozen=True)
@@ -110,6 +117,11 @@ class CrossSpec:
 
     stats: tuple[str, ...]
     d2: Callable
+    # Ratio kernels only: ``num(acc)`` finalizes the accumulated cross
+    # statistics into the similarity NUMERATOR (query x panel), the
+    # quantity a factorized dual model scales by 1/(a_q a_j) to project
+    # without the dense panel. None = no factorized projection path.
+    num: Callable | None = None
 
 
 @dataclass(frozen=True)
@@ -338,3 +350,74 @@ def check_sketchable(metric: str, solver: str) -> None:
     if (isinstance(spec, DualSketch) and solver == "sketch"
             and not spec.num_psd):
         raise ValueError(unsketchable_metric_error(metric, solver))
+
+
+def factorized_savable_names() -> tuple[str, ...]:
+    """Metrics whose sketch-rung fits can be saved as a factorized
+    model: pca-family factor kernels on either rung, dual kernels with
+    a cross numerator on the corrected rung."""
+    return tuple(
+        k.name for k in _REGISTRY.values()
+        if (isinstance(k.sketch, FactorSketch) and k.sketch.pca_family)
+        or (isinstance(k.sketch, DualSketch) and k.cross is not None
+            and k.cross.num is not None)
+    )
+
+
+def check_factorized_savable(metric: str | None, solver: str,
+                             kind: str | None = None) -> None:
+    """Raise unless a ``--save-model`` fit of ``metric`` on the sketch
+    ladder rung ``solver`` can produce a servable factorized model.
+    Shared by config-time validation (``kind`` unknown there — a
+    JobConfig serves pcoa, pca, and similarity alike, so only combos
+    invalid for EVERY kind are rejected) and the run-time driver gate
+    (``kind`` known; the kind-specific rows resolve). ``exact`` never
+    reaches the factorized path and always passes."""
+    if solver == "exact":
+        return
+    if metric is None:
+        if kind is None:
+            return  # defer: the driver default resolves at run time
+        metric = "shared-alt" if kind == "pca" else "ibs"
+    kern = _REGISTRY.get(metric)
+    spec = kern.sketch if kern is not None else None
+    savable = " | ".join(factorized_savable_names())
+    if isinstance(spec, DualSketch):
+        if kern.cross is None or kern.cross.num is None:
+            raise ValueError(
+                f"--save-model with --solver {solver}: --metric {metric} "
+                "declares no cross numerator, so a factorized model of "
+                f"it cannot project queries — savable sketch metrics: "
+                f"{savable}, or fit with --solver exact"
+            )
+        if solver != "corrected":
+            raise ValueError(
+                f"--save-model with --metric {metric}: the dual "
+                "centering statistics stream only in the corrected "
+                "rung's scaled power passes (the denominator scale "
+                "does not exist during pass 0) — use --solver "
+                "corrected, or fit with --solver exact"
+            )
+        return
+    if isinstance(spec, FactorSketch):
+        if not spec.pca_family:
+            raise ValueError(
+                f"--save-model with --solver {solver}: --metric {metric} "
+                "has no factorized projection path (its factor is not "
+                "the PCA similarity and it declares no cross spec) — "
+                f"savable sketch metrics: {savable}, or fit with "
+                "--solver exact"
+            )
+        if kind == "pcoa":
+            raise ValueError(
+                f"--save-model with --solver {solver}: a pcoa fit of "
+                f"--metric {metric} serves the Gower geometry, which "
+                "the factorized artifact stores only for ratio "
+                f"metrics ({' | '.join(dual_sketch_names())}) — save "
+                "the pca fit instead, or fit with --solver exact"
+            )
+        return
+    # No sketch spec at all: check_sketchable already rejects the rung
+    # itself with the registry-derived text; repeat it here so this
+    # gate is safe to call first.
+    raise ValueError(unsketchable_metric_error(metric, solver))

@@ -90,6 +90,15 @@ def _ibs_cross_d2(acc):
     return dist * dist
 
 
+def _ibs_cross_num(acc):
+    import jax.numpy as jnp
+
+    # The dual sketch's similarity numerator NUM = 2m - d1 between a
+    # query row and each panel sample — the same quantity the fit
+    # streamed as sum_v c_i c_j (2 - |a-b|), from the cross statistics.
+    return (2.0 * acc["m"] - acc["d1"]).astype(jnp.float32)
+
+
 def _ibs_pair_sim(acc):
     import numpy as np
 
@@ -124,7 +133,8 @@ register(Kernel(
         den_terms=(("c", "c", 2.0),),
         num_psd=True,
     ),
-    cross=CrossSpec(stats=("m", "d1"), d2=_ibs_cross_d2),
+    cross=CrossSpec(stats=("m", "d1"), d2=_ibs_cross_d2,
+                    num=_ibs_cross_num),
     pair=PairSpec(stats=("m", "d1"), sim=_ibs_pair_sim),
 ))
 
@@ -196,7 +206,10 @@ register(Kernel(
     pack_auto=True,
     max_increment=1,
     flops=_count_flops(("t1t1",)),
-    sketch=FactorSketch(features=_shared_alt_features),
+    # pca_family: the factor IS the PCA similarity (S = T1 T1^T, no
+    # denominator), so a sketch-rung fit saves as a factorized PCA
+    # model served with the exact route's centering formula.
+    sketch=FactorSketch(features=_shared_alt_features, pca_family=True),
 ))
 
 
@@ -377,6 +390,14 @@ def _jaccard_dual_operands(block):
 _jaccard_dual_operands.operand_names = ("c", "t1")
 
 
+def _jaccard_cross_num(acc):
+    import jax.numpy as jnp
+
+    # The dual sketch's numerator is the raw intersection count
+    # NUM = T1 T1^T — for a query row, exactly the streamed ``s``.
+    return acc["s"].astype(jnp.float32)
+
+
 def _jaccard_cross_d2(acc):
     import jax.numpy as jnp
 
@@ -429,7 +450,8 @@ register(Kernel(
                    ("t1", "t1", -1.0)),
         num_psd=True,
     ),
-    cross=CrossSpec(stats=("s", "sn", "sr"), d2=_jaccard_cross_d2),
+    cross=CrossSpec(stats=("s", "sn", "sr"), d2=_jaccard_cross_d2,
+                    num=_jaccard_cross_num),
     pair=PairSpec(stats=("s", "sn", "sr"), sim=_jaccard_pair_sim),
 ))
 

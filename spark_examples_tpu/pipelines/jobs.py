@@ -160,9 +160,39 @@ def _sketch_route(job: JobConfig, source, timer, kind: str) -> CoordsOutput:
     from spark_examples_tpu.solvers import run_sketch_solve
 
     res = run_sketch_solve(job, source, timer, kind=kind)
+    _maybe_save_factorized_model(job, kind, res)
     return _emit_coords(job, res.sample_ids, res.coords, res.eigenvalues,
                         timer, res.n_variants, method="sketch",
                         eigh_iters=res.passes, proportion=res.proportion)
+
+
+def _maybe_save_factorized_model(job, kind: str, res) -> None:
+    """Persist a sketch-rung fit as a factorized artifact when the job
+    asks for it — the sketch ladder's --save-model (the savable
+    rung/metric combinations were validated at config time and again by
+    the driver; by here ``res`` carries the basis and the streamed
+    centering statistics)."""
+    if not job.model_path or jax.process_index() != 0:
+        return
+    from spark_examples_tpu.models.factorized import save_factorized_model
+
+    metric = ("shared-alt" if kind == "pca"
+              else (job.compute.metric or "ibs"))
+    save_factorized_model(
+        job.model_path,
+        family="pca" if kind == "pca" else "pcoa",
+        metric=metric,
+        eigenvectors=res.eigvecs,
+        eigenvalues=res.eigenvalues,
+        colmean=res.colmean,
+        grand=res.grand,
+        sample_ids=res.sample_ids,
+        solver=res.rung,
+        rank=res.rank,
+        seed=res.seed,
+        scale=res.scale,
+        scale_floor=res.scale_floor,
+    )
 
 
 def _emit_coords(job: JobConfig, sample_ids, coords, vals, timer,

@@ -183,6 +183,15 @@ def load_model(path: str) -> ProjectionModel:
                     f"model file {path!r} is missing the 'kind' field"
                 )
             kind = str(mdl["kind"])
+            if kind == "factorized":
+                # Sketch-ladder artifact (models/factorized.py) — same
+                # archive container, its own field set and family
+                # dispatch. Lazy import: this module must stay loadable
+                # without the models package (and the reverse import,
+                # factorized -> ModelFormatError, is top-level there).
+                from spark_examples_tpu.models import factorized as FZ
+
+                return FZ.parse_factorized(mdl, path, version)
             if kind not in _MODEL_KEYS:
                 raise ModelFormatError(
                     f"model file {path!r} has unknown kind {kind!r} "
@@ -221,9 +230,13 @@ def load_model(path: str) -> ProjectionModel:
         ) from None
 
 
-def check_projectable(model: ProjectionModel) -> tuple[str, ...]:
+def check_projectable(model) -> tuple[str, ...]:
     """The (kind, metric) projectability gate, shared by the offline job
     and the serving engine — returns the cross statistics to stream."""
+    if getattr(model, "kind", None) == "factorized":
+        from spark_examples_tpu.models import factorized as FZ
+
+        return FZ.check_factorized_projectable(model)
     stats = PROJECTABLE.get((model.kind, model.metric))
     if stats is None:
         raise ValueError(
@@ -454,7 +467,7 @@ def clear_caches() -> None:
     unboundedly under a reload loop)."""
     _CROSS_UPDATE_CACHE.clear()
     for fn in (_update_cross, _af_moments, _cross_phi, _project,
-               _project_pca):
+               _project_pca, _den_diag, _project_factorized_dual):
         clear = getattr(fn, "clear_cache", None)
         if clear is not None:
             clear()
@@ -558,16 +571,20 @@ def _check_af_concordance(moments: np.ndarray, a: int, n_ref: int) -> None:
 
 def _accumulate_cross(job, source_new, source_ref,
                       stats: tuple[str, ...], timer,
-                      plan: CrossPlan | None = None):
+                      plan: CrossPlan | None = None,
+                      den_metric: str | None = None):
     """Stream BOTH cohorts in lockstep and accumulate the requested
     cross statistics — the shared engine of projection and
     cross-kinship. Zips manually so a length mismatch is an ERROR, not
     a silent prefix (and without consulting n_variants up front — for
     VCF/filtered sources that property is a full extra parse); block
     boundaries and, when available, positions are validated per block.
-    Returns (accumulators, n_variants); under a tile2d ``plan`` the
-    accumulators stay tiled across the mesh (no full (A, N_ref) leaf on
-    any device — verified per job by an assert_tiled check)."""
+    Returns (accumulators, n_variants, qden); under a tile2d ``plan``
+    the accumulators stay tiled across the mesh (no full (A, N_ref)
+    leaf on any device — verified per job by an assert_tiled check).
+    ``den_metric`` additionally folds that dual-sketch metric's query
+    denominator diagonal (the (A,) self-term of factorized-pcoa
+    projection) into the same pass; qden is None when unset."""
     multihost = jax.process_count() > 1
     a = source_new.n_samples
     n_ref = source_ref.n_samples
@@ -598,6 +615,8 @@ def _accumulate_cross(job, source_new, source_ref,
         update = _update_cross
         acc = {k: jnp.zeros((a, n_ref), jnp.int32) for k in stats}
     moment_blocks = []  # tiny per-block device vectors, reduced in f64
+    qden = (jnp.zeros((a,), jnp.float32)
+            if den_metric is not None else None)
     n_variants = 0
     n_matmuls = sum(len(genotype.CROSS_STATS[s]) for s in stats)
     with timer.phase("gram"):
@@ -641,6 +660,8 @@ def _accumulate_cross(job, source_new, source_ref,
                     f"[{mn.start}, {mn.stop}) — not the same variant set"
                 )
             acc = update(acc, bn, br)
+            if qden is not None:
+                qden = _den_diag(qden, bn, metric=den_metric)
             moment_blocks.append(_af_moments(bn, br))
             timer.add("gram_flops",
                       2.0 * a * n_ref * bn.shape[1] * n_matmuls)
@@ -677,11 +698,16 @@ def _accumulate_cross(job, source_new, source_ref,
             k: jnp.asarray(mh.allreduce_sum(np.asarray(v)))
             for k, v in acc.items()
         }
+        if qden is not None:
+            # Integer-valued f32 per-process partial sums — the merged
+            # diagonal is exact for the same reason the per-process
+            # one is (totals far below 2^24).
+            qden = jnp.asarray(mh.allreduce_sum(np.asarray(qden)))
         n_variants = int(mh.allgather(np.int64(n_variants)).sum())
         moments = mh.allgather(moments).sum(axis=0)
     if moments[0] > 0:
         _check_af_concordance(moments, a, n_ref)
-    return acc, n_variants
+    return acc, n_variants, qden
 
 
 @partial(jax.jit, static_argnames=())
@@ -704,7 +730,7 @@ def cross_kinship_job(job, source_new, source_ref):
     from spark_examples_tpu.pipelines.runner import SimilarityResult
 
     timer = PhaseTimer()
-    acc, n_variants = _accumulate_cross(
+    acc, n_variants, _ = _accumulate_cross(
         job, source_new, source_ref, ("hh", "opp", "hcn", "hcr"), timer
     )
     R._check_int32_budget("king", n_variants, 2)
@@ -760,6 +786,41 @@ def _project_pca(s, s_colmean, s_grand, eigvecs):
     return c @ eigvecs
 
 
+@partial(jax.jit, static_argnames=("metric",), donate_argnums=(0,))
+def _den_diag(qden, block, metric):
+    """Accumulate the QUERY side of a dual-sketch metric's denominator
+    diagonal from one genotype block: the kernel's declared ``den_terms``
+    evaluated row-against-itself (the (q, q) entry of the denominator
+    gram, never the matrix). Matches the fit-side exact diagonal the
+    corrected rung streamed into the saved model's ``scale`` — both are
+    plain sums of integer-valued per-variant terms, so the f32 running
+    sum here is exact (and partition-invariant) up to 2^24, far above
+    any per-sample total a 65k-variant panel can produce."""
+    spec = kernels.get(metric).sketch
+    ops = spec.operands(block)
+    for (left, right, w) in spec.den_terms:
+        qden = qden + w * (ops[left] * ops[right]).sum(axis=1)
+    return qden
+
+
+@partial(jax.jit, static_argnames=("metric",))
+def _project_factorized_dual(acc, qden, scale, scale_floor, colmean,
+                             grand, eigvecs, eigvals, metric):
+    """Factorized out-of-sample projection for a pcoa-family model: the
+    kernel's cross NUMERATOR scaled by both denominator diagonals gives
+    the scaled similarity s~; with self-similarity pinned at 1 the
+    Gower double-centering of d2 = 2 - 2 s~ reduces exactly to
+    ``b = s~ - rowmean - colmean + grand`` in s~-space (the identity
+    the saved colmean/grand are expressed in), then coordinates are
+    ``(b @ V) / sqrt(lambda)`` — an (A, k) product, no (N, N) anywhere.
+    The query scale gets the same floor the fit applied to the panel's."""
+    num = kernels.get(metric).cross.num(acc)
+    aq = jnp.maximum(jnp.sqrt(jnp.maximum(qden, 0.0)), scale_floor)
+    s = num / (aq[:, None] * scale[None, :])
+    b = s - s.mean(axis=1, keepdims=True) - colmean[None, :] + grand
+    return (b @ eigvecs) / jnp.sqrt(eigvals)[None, :]
+
+
 def pcoa_project_job(
     job: JobConfig,
     model_path: str,
@@ -775,7 +836,7 @@ def pcoa_project_job(
     """
     model = load_model(model_path)
     kind, metric = model.kind, model.metric
-    check_projectable(model)
+    stats = check_projectable(model)
     check_reference_panel(model, source_ref)
     eigvecs = jnp.asarray(model.eigvecs, jnp.float32)
     eigvals = jnp.asarray(model.eigvals, jnp.float32)
@@ -783,11 +844,16 @@ def pcoa_project_job(
         jnp.asarray(model.colmean, jnp.float32),
         jnp.float32(model.grand),
     )
+    # Factorized models project family-wise: the pca family reuses the
+    # dense _project_pca program verbatim; the pcoa family needs the
+    # query denominator diagonal folded into the same cross pass.
+    family = getattr(model, "family", kind)
+    needs_qden = kind == "factorized" and family == "pcoa"
 
     timer = PhaseTimer()
-    stats = PROJECTABLE[(kind, metric)]
-    acc, n_variants = _accumulate_cross(
-        job, source_new, source_ref, stats, timer
+    acc, n_variants, qden = _accumulate_cross(
+        job, source_new, source_ref, stats, timer,
+        den_metric=metric if needs_qden else None,
     )
     # Same int32-exactness guard as the symmetric path (the kernel's
     # registered increment bound); warns when counts may have wrapped.
@@ -795,9 +861,17 @@ def pcoa_project_job(
     # One fused device step: finalize cross statistics + out-of-sample
     # centering + eigvec products; only the (A, k) coordinates come home.
     with timer.phase("eigh"):
-        if kind == "pca":
+        if family == "pca":
             coords = np.asarray(hard_sync(_project_pca(
                 acc["s"], center_stats[0], center_stats[1], eigvecs
+            )))
+        elif needs_qden:
+            coords = np.asarray(hard_sync(_project_factorized_dual(
+                acc, qden,
+                jnp.asarray(model.scale, jnp.float32),
+                jnp.float32(model.scale_floor),
+                center_stats[0], center_stats[1],
+                eigvecs, eigvals, metric=metric,
             )))
         else:
             coords = np.asarray(hard_sync(_project(

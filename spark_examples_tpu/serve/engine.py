@@ -56,6 +56,13 @@ class ModelContext:
             model = P.load_model(model)
         self.stats = P.check_projectable(model)
         self.model = model
+        # Factorized (sketch-ladder) models project family-wise; dense
+        # models' kind IS their family. The pcoa family of a factorized
+        # model additionally needs the query denominator diagonal
+        # (qden) accumulated alongside the cross statistics.
+        self.family = getattr(model, "family", model.kind)
+        self.needs_qden = (model.kind == "factorized"
+                           and self.family == "pcoa")
         # f32 casts at the device boundary — exactly what the offline
         # path does with the freshly np.load-ed f64 arrays.
         self._eigvecs = jax.device_put(
@@ -65,6 +72,10 @@ class ModelContext:
         self._colmean = jax.device_put(
             np.asarray(model.colmean, np.float32))
         self._grand = jnp.float32(model.grand)
+        if self.needs_qden:
+            self._scale = jax.device_put(
+                np.asarray(model.scale, np.float32))
+            self._scale_floor = jnp.float32(model.scale_floor)
 
     @property
     def n_ref(self) -> int:
@@ -74,14 +85,22 @@ class ModelContext:
     def n_components(self) -> int:
         return self.model.n_components
 
-    def finalize_row(self, acc, i: int):
+    def finalize_row(self, acc, i: int, qden=None):
         """One live row at shape (1, N_ref) through the SAME compiled
         finalize as the offline single-query path — the bit-identity
-        anchor."""
-        if self.model.kind == "pca":
+        anchor. ``qden`` is the (max_batch,) query denominator diagonal
+        a factorized-pcoa batch accumulated; unused otherwise."""
+        if self.family == "pca":
             return P._project_pca(
                 acc["s"][i:i + 1], self._colmean, self._grand,
                 self._eigvecs,
+            )
+        if self.needs_qden:
+            return P._project_factorized_dual(
+                {k: v[i:i + 1] for k, v in acc.items()}, qden[i:i + 1],
+                self._scale, self._scale_floor, self._colmean,
+                self._grand, self._eigvecs, self._eigvals,
+                metric=self.model.metric,
             )
         return P._project(
             {k: v[i:i + 1] for k, v in acc.items()}, self._colmean,
@@ -118,11 +137,18 @@ def batch_coords(ctx: ModelContext, ref_blocks, genotypes: np.ndarray,
         k: jnp.zeros((max_batch, ctx.n_ref), jnp.int32)
         for k in ctx.stats
     }
+    qden = (jnp.zeros((max_batch,), jnp.float32)
+            if ctx.needs_qden else None)
     for ref_dev, meta in ref_blocks:
         q = jax.device_put(
             np.ascontiguousarray(g[:, meta.start:meta.stop]))
         acc = P._update_cross(acc, q, ref_dev)
-    rows = [np.asarray(ctx.finalize_row(acc, i)) for i in range(b)]
+        if qden is not None:
+            # The SAME jitted accumulation the offline factorized path
+            # runs (padding rows get a qden that is never read).
+            qden = P._den_diag(qden, q, metric=ctx.model.metric)
+    rows = [np.asarray(ctx.finalize_row(acc, i, qden))
+            for i in range(b)]
     return np.concatenate(rows, axis=0)
 
 
@@ -132,7 +158,11 @@ def check_topkable(model) -> "kernels.PairSpec":
     king). PCA models have no similarity metric at all; projectable
     metrics without a PairSpec can project but not rank neighbors."""
     metric = getattr(model, "metric", None)
-    if model.kind == "pca" or not metric:
+    # Family-aware: a factorized pcoa model ranks neighbors exactly as
+    # a dense one does (pairwise similarity is model-independent), so
+    # only the pca FAMILY is metric-less, whichever artifact carries it.
+    family = getattr(model, "family", model.kind)
+    if family == "pca" or not metric:
         raise ValueError(
             "topk serving needs a metric-bearing (pcoa) model — PCA "
             "models carry no pairwise similarity to rank neighbors by"
@@ -219,6 +249,33 @@ def stage_blocks(source_ref, block_variants: int) -> tuple[list, int, int]:
     if n_variants == 0:
         raise ValueError("reference source yielded no variants")
     return blocks, n_variants, nbytes
+
+
+def shard_stream(source_ref, block_variants: int, max_shard_bytes: int):
+    """Shard-staged panel feed: group a panel's dense int8 blocks into
+    consecutive shards of at most ``max_shard_bytes`` device bytes and
+    yield ``(blocks, nbytes)`` per shard, device-putting each shard's
+    blocks only at yield time. The serving loop (router._sharded_blocks)
+    serves one shard and drops it before pulling the next, so peak
+    device residency is ONE shard — the mechanism that lets a fleet
+    route serve a panel larger than the whole pool budget. A shard
+    always carries at least one block (a single block wider than the
+    budget still streams, it just cannot be split); while shard k is
+    being served the generator holds at most one pending HOST block of
+    shard k+1 (host RAM, not HBM). Block partitioning is unchanged, so
+    the cross accumulation — integer sums, partition-invariant — is
+    bit-identical to whole-panel staging."""
+    pending: list = []
+    nbytes = 0
+    for block, meta in source_ref.blocks(block_variants):
+        b = int(block.nbytes)
+        if pending and nbytes + b > max_shard_bytes:
+            yield [(jax.device_put(h), m) for h, m in pending], nbytes
+            pending, nbytes = [], 0
+        pending.append((block, meta))
+        nbytes += b
+    if pending:
+        yield [(jax.device_put(h), m) for h, m in pending], nbytes
 
 
 def _store_cache_of(source):

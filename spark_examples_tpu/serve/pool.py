@@ -36,6 +36,7 @@ from __future__ import annotations
 import threading
 import warnings
 from collections import OrderedDict
+from contextlib import contextmanager
 from dataclasses import dataclass
 
 from spark_examples_tpu.core import faults, telemetry
@@ -70,6 +71,12 @@ class PanelPool:
         self.budget_bytes = int(budget_bytes)
         self._lock = threading.Lock()
         self._entries: OrderedDict[str, StagedPanel] = OrderedDict()
+        # Shard-staged residency (router._sharded_blocks): bytes a
+        # route is holding transiently while one shard of an
+        # over-budget panel serves. Charged against the same budget
+        # (they evict warm panels) but never evictable themselves —
+        # evicting the shard being computed on would tear the batch.
+        self._transient: dict[str, int] = {}
         self._ever_staged: set[str] = set()
         self._warned_oversize: set[str] = set()
 
@@ -77,7 +84,8 @@ class PanelPool:
 
     def resident_bytes(self) -> int:
         with self._lock:
-            return sum(e.nbytes for e in self._entries.values())
+            return (sum(e.nbytes for e in self._entries.values())
+                    + sum(self._transient.values()))
 
     def pressure(self) -> float:
         """resident / budget (the autoscale signal)."""
@@ -94,10 +102,13 @@ class PanelPool:
 
     def stats(self) -> dict:
         with self._lock:
-            resident = sum(e.nbytes for e in self._entries.values())
+            transient = sum(self._transient.values())
+            resident = (sum(e.nbytes for e in self._entries.values())
+                        + transient)
             return {
                 "budget_bytes": self.budget_bytes,
                 "resident_bytes": resident,
+                "transient_bytes": transient,
                 "pressure": resident / self.budget_bytes,
                 "staged_routes": list(self._entries),
             }
@@ -154,25 +165,58 @@ class PanelPool:
         return entry
 
     def _evict_over_budget_locked(self, keep: str) -> None:
-        resident = sum(e.nbytes for e in self._entries.values())
+        resident = (sum(e.nbytes for e in self._entries.values())
+                    + sum(self._transient.values()))
         while resident > self.budget_bytes:
             victim = next((r for r in self._entries if r != keep), None)
             if victim is None:
-                # A single panel larger than the whole budget: serve it
-                # anyway (evicting it would deadlock the route), but
-                # say so once — the budget is not being honored.
+                # Everything left is ``keep``'s own bytes (or transient
+                # shard residency) and it still exceeds the budget:
+                # serve anyway (evicting it would deadlock the route),
+                # but say so once. Routes whose panel length is known
+                # up front never land here — the router serves their
+                # over-budget panels shard-staged (_sharded_blocks)
+                # instead of staging them whole; only a length-blind
+                # source or a direct acquire of an oversized panel can.
                 if keep not in self._warned_oversize:
                     self._warned_oversize.add(keep)
                     warnings.warn(
-                        f"route {keep!r}: its panel alone "
-                        f"({resident} B) exceeds the pool budget "
+                        f"route {keep!r}: its resident bytes alone "
+                        f"({resident} B) exceed the pool budget "
                         f"({self.budget_bytes} B) — serving it "
-                        "unevictable; raise --fleet-budget-mb",
+                        "unevictable; raise --fleet-budget-mb (panels "
+                        "with a known length serve shard-staged "
+                        "instead)",
                         RuntimeWarning, stacklevel=3,
                     )
                 return
             resident -= self._entries.pop(victim).nbytes
             telemetry.count("fleet.evictions")
+
+    @contextmanager
+    def transient(self, route: str, nbytes: int):
+        """Charge ``nbytes`` of shard residency for ``route`` while the
+        body runs: the shard counts against the budget exactly like a
+        warm panel (entering may evict other routes' LRU panels) but is
+        never an eviction candidate itself, and the charge is released
+        when the shard is dropped — the accounting half of shard-staged
+        serving (router._sharded_blocks owns the staging half)."""
+        nbytes = int(nbytes)
+        with self._lock:
+            self._transient[route] = (
+                self._transient.get(route, 0) + nbytes)
+            self._evict_over_budget_locked(keep=route)
+            self._publish_locked()
+        try:
+            yield
+        finally:
+            with self._lock:
+                left = self._transient.get(route, 0) - nbytes
+                if left > 0:
+                    self._transient[route] = left
+                else:
+                    self._transient.pop(route, None)
+                self._publish_locked()
 
     # -- admin -------------------------------------------------------------
 
@@ -197,7 +241,8 @@ class PanelPool:
             return entry is not None
 
     def _publish_locked(self) -> None:
-        resident = sum(e.nbytes for e in self._entries.values())
+        resident = (sum(e.nbytes for e in self._entries.values())
+                    + sum(self._transient.values()))
         telemetry.gauge_set("fleet.pool_bytes", float(resident))
         telemetry.gauge_set("fleet.pool_pressure",
                             resident / self.budget_bytes)

@@ -113,6 +113,16 @@ class Route:
     def cache_ns(self) -> str:
         return self.ctx.model.digest()
 
+    @property
+    def panel_bytes_hint(self) -> int | None:
+        """Projected dense panel residency (n_ref x n_variants int8
+        bytes), or None before the panel length is known. The router
+        compares it to the pool budget to choose whole-panel staging
+        vs shard-staged serving BEFORE any bytes move."""
+        if self.n_variants is None:
+            return None
+        return int(self.ctx.n_ref) * int(self.n_variants)
+
     def bump(self, key: str, n: int = 1) -> None:
         with self.tally_lock:
             self.tally[key] += n
@@ -357,8 +367,21 @@ class FleetRouter:
 
     def warm_route(self, name: str) -> None:
         """Stage a route's panel now (startup warming) instead of on
-        first demand."""
+        first demand. Over-budget routes (panel_bytes_hint exceeds the
+        pool budget) have no warm state to pre-stage — they serve
+        shard-staged per request — so warming them is a no-op with a
+        warning, not a budget violation."""
         route = self._route(name)
+        hint = route.panel_bytes_hint
+        if hint is not None and hint > self.pool.budget_bytes:
+            warnings.warn(
+                f"route {name!r}: its panel (~{hint} B) exceeds the "
+                f"pool budget ({self.pool.budget_bytes} B), so it "
+                "serves shard-staged per request and cannot be kept "
+                "warm — raise --fleet-budget-mb to warm it",
+                RuntimeWarning, stacklevel=2,
+            )
+            return
         with self._engine_lock:
             self.pool.acquire(route.name, route.stage,
                               breaker=route.breaker)
@@ -745,6 +768,72 @@ class FleetRouter:
                 self._note_recovery(f"worker loop error: {e!r}")
                 time.sleep(0.005)
 
+    def _sharded_blocks(self, route: Route):
+        """Shard-staged panel feed for a route whose panel exceeds the
+        pool budget: a generator of ``(device_block, meta)`` pairs that
+        stages the panel as a SEQUENCE of store-fed shards (engine.
+        shard_stream), each at most one budget's worth of bytes. Every
+        shard stage runs the same protocol as a pooled stage — breaker
+        admission (PanelUnavailable when open; the first shard of the
+        next request is the half-open probe), a ``fleet.stage`` span
+        with the ``fleet.stage`` fault site fired first, breaker
+        feedback — and charges the pool as transient residency
+        (evicting other routes' warm panels, never evictable itself)
+        for exactly as long as its blocks are being consumed. The
+        consumer is the UNCHANGED batch_coords/batch_pair_sims loop:
+        integer cross accumulation is partition-invariant, so sharded
+        answers are bit-identical to whole-panel ones by construction.
+        Runs under the engine lock (only the worker consumes it)."""
+        src = route.panel_source_fn()
+        try:
+            from spark_examples_tpu.pipelines import project as P
+
+            P.check_reference_panel(route.ctx.model, src)
+            it = E.shard_stream(src, route.block_variants,
+                                self.pool.budget_bytes)
+            shard = 0
+            stop = 0
+            while True:
+                if not route.breaker.allow():
+                    raise PanelUnavailable(
+                        f"route {route.name!r}: shard {shard} cannot "
+                        f"stage — the store breaker is "
+                        f"{route.breaker.state}; attempts are short-"
+                        "circuited until the reset window's probe"
+                    )
+                try:
+                    with telemetry.span("fleet.stage", cat="fleet",
+                                        route=route.name, shard=shard):
+                        faults.fire("fleet.stage")
+                        item = next(it, None)
+                except Exception:
+                    route.breaker.record_failure()
+                    raise
+                except BaseException:
+                    # SIGINT/SystemExit mid-stage says nothing about
+                    # the store: give the half-open probe slot back.
+                    route.breaker.release_probe()
+                    raise
+                route.breaker.record_success()
+                if item is None:
+                    break
+                blocks, nbytes = item
+                telemetry.count("fleet.shard_stages")
+                shard += 1
+                with self.pool.transient(route.name, nbytes):
+                    yield from blocks
+                stop = blocks[-1][1].stop
+                del blocks  # free the shard before staging the next
+            if stop != route.n_variants:
+                raise ValueError(
+                    f"route {route.name!r}: sharded panel streamed "
+                    f"{stop} variants, expected {route.n_variants} — "
+                    "the panel changed under the model; refit it"
+                )
+            route.bump("stages")
+        finally:
+            _close_source(src)
+
     def _process(self, batch: list[_Pending]) -> None:
         route = self.routes.get(batch[0].route)
         with telemetry.span("serve.assemble", cat="serve"):
@@ -813,21 +902,39 @@ class FleetRouter:
         kind = live[0].kind  # take_batch coalesces within one kind
         with telemetry.span("serve.device_step", cat="serve",
                             rows=len(live), route=route.name):
+            hint = route.panel_bytes_hint
+            sharded = hint is not None and hint > self.pool.budget_bytes
             try:
                 with self._engine_lock:
-                    panel = self.pool.acquire(route.name, route.stage,
-                                              breaker=route.breaker)
-                    t_compute = time.perf_counter()
-                    if cold:
-                        stage_s = t_compute - t_device
+                    if sharded:
+                        # The panel cannot fit warm: feed the SAME
+                        # batch loop a shard-staged block stream
+                        # instead of a pooled panel. Staging overlaps
+                        # compute, so stage_s stays 0 and every
+                        # request is honestly cold.
+                        telemetry.gauge_set(
+                            "fleet.panel_over_budget_x",
+                            hint / self.pool.budget_bytes)
+                        blocks = self._sharded_blocks(route)
+                        n_variants = route.n_variants
+                        t_compute = time.perf_counter()
+                    else:
+                        panel = self.pool.acquire(
+                            route.name, route.stage,
+                            breaker=route.breaker)
+                        blocks = panel.blocks
+                        n_variants = panel.n_variants
+                        t_compute = time.perf_counter()
+                        if cold:
+                            stage_s = t_compute - t_device
                     if kind == "topk":
                         sims = E.batch_pair_sims(
-                            route.ctx, panel.blocks, g, self.max_batch,
-                            panel.n_variants)
+                            route.ctx, blocks, g, self.max_batch,
+                            n_variants)
                     else:
                         coords = E.batch_coords(
-                            route.ctx, panel.blocks, g, self.max_batch,
-                            panel.n_variants)
+                            route.ctx, blocks, g, self.max_batch,
+                            n_variants)
             except BaseException as e:  # incl. PanelUnavailable
                 telemetry.count("serve.errors", len(live))
                 route.bump("errors", len(live))

@@ -59,6 +59,17 @@ class SketchSolveResult:
     rung: str
     rank: int
     passes: int
+    # Model-artifact payload (populated only when the job carries
+    # --save-model and the rung/metric combination can persist one —
+    # see kernels.check_factorized_savable): the RAW Ritz basis plus
+    # the streamed centering statistics, and for dual metrics the
+    # denominator scale diagonal with its floor.
+    eigvecs: np.ndarray | None = None
+    colmean: np.ndarray | None = None
+    grand: float | None = None
+    scale: np.ndarray | None = None
+    scale_floor: float = 0.0
+    seed: int = 0
 
 
 def sketch_plan(job: JobConfig) -> GramPlan:
@@ -87,11 +98,10 @@ def run_sketch_solve(job: JobConfig, source, timer: PhaseTimer,
             "oracle implements the dense reference route only"
         )
     if job.model_path:
-        raise ValueError(
-            "--save-model needs the dense distance/similarity matrix for "
-            "the projection centering statistics, which the sketch route "
-            "never materializes — fit the model with --solver exact"
-        )
+        # Config-time validation already ran (JobConfig.__post_init__);
+        # this defense-in-depth call also knows the resolved kind, so a
+        # hand-built config cannot sneak an unsavable combination in.
+        kernels.check_factorized_savable(metric, cfg.solver, kind)
     plan = sketch_plan(job)
     if jax.process_count() > 1:
         raise ValueError(
@@ -197,6 +207,11 @@ def run_sketch_solve(job: JobConfig, source, timer: PhaseTimer,
     else:
         coords = np.asarray(coords_from_eigpairs(vals, vecs))
         prop = np.maximum(vals_np, 0.0) / max(float(np.asarray(tr)), 1e-30)
+    colmean = grand = None
+    if job.model_path:
+        # Finalize the streamed column mass into the centering
+        # statistics the factorized artifact persists (jobs.py saves).
+        colmean, grand = sketch.factor_centering(state)
     return SketchSolveResult(
         sample_ids=source.sample_ids,
         eigenvalues=vals_np,
@@ -206,6 +221,10 @@ def run_sketch_solve(job: JobConfig, source, timer: PhaseTimer,
         rung=cfg.solver,
         rank=int(rank),
         passes=passes,
+        eigvecs=np.asarray(vecs) if job.model_path else None,
+        colmean=colmean,
+        grand=grand,
+        seed=int(cfg.sketch_seed),
     )
 
 
@@ -346,6 +365,17 @@ def _run_dual_solve(job: JobConfig, source, timer: PhaseTimer, kind: str,
 
     vals_np = np.asarray(vals)
     coords = np.asarray(coords_from_eigpairs(vals, vecs))
+    colmean = scale_np = None
+    grand = None
+    floor = 0.0
+    if job.model_path and cfg.solver == "corrected":
+        # The dual column mass streams only on the scaled power passes
+        # (the scale does not exist during pass 0), so only the
+        # corrected rung can persist a factorized artifact — the
+        # savable-combination gates upstream enforce exactly this; the
+        # rung check here is defense-in-depth, not policy.
+        colmean, grand, floor = sketch.dual_centering(state)
+        scale_np = np.asarray(state["scale"], np.float64)
     return SketchSolveResult(
         sample_ids=source.sample_ids,
         eigenvalues=vals_np,
@@ -355,4 +385,11 @@ def _run_dual_solve(job: JobConfig, source, timer: PhaseTimer, kind: str,
         rung=cfg.solver,
         rank=int(rank),
         passes=passes,
+        eigvecs=(np.asarray(vecs)
+                 if job.model_path and cfg.solver == "corrected" else None),
+        colmean=colmean,
+        grand=grand,
+        scale=scale_np,
+        scale_floor=floor,
+        seed=int(cfg.sketch_seed),
     )

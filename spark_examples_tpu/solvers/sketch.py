@@ -55,15 +55,22 @@ from spark_examples_tpu.parallel.gram_sharded import GramPlan
 
 # Checkpointable accumulator leaves (core/checkpoint.py saves them like
 # any gram accumulator; the pass index rides in the manifest's extra).
-STATE_LEAVES = ("y", "qc", "trace", "nvar")
+# ``cm`` is the streamed column-mass vector S @ 1 = A (A^T 1) — the
+# per-sample similarity column sums the factorized model's projection
+# centering (colmean/grand) is finalized from, accumulated in the SAME
+# block update as the sketch so kill/resume keeps it bit-identical.
+STATE_LEAVES = ("y", "qc", "trace", "nvar", "cm")
 
 # The dual-sketch (ratio-metric) state: numerator sketch ``y``,
 # denominator sketch ``yd``, the EXACT streamed denominator diagonal
 # ``d`` (per-sample pair-count mass — one rowsum per term per block),
 # the orthonormal test basis ``q``, the streamed probe block ``qc``
-# (= q / a per row after pass 0), and the rank-1 denominator factor
-# ``scale`` (= a = sqrt(d); ones until pass 0 ends).
-DUAL_STATE_LEAVES = ("y", "yd", "d", "q", "qc", "scale")
+# (= q / a per row after pass 0), the rank-1 denominator factor
+# ``scale`` (= a = sqrt(d); ones until pass 0 ends), and ``cm`` — the
+# scaled-similarity column mass NUM @ (1/a), streamed on passes >= 1
+# only (the scale does not exist during pass 0), which is why the dual
+# centering stats — and --save-model — need the corrected rung.
+DUAL_STATE_LEAVES = ("y", "yd", "d", "q", "qc", "scale", "cm")
 
 
 def check_sketchable(metric: str, solver: str) -> None:
@@ -130,14 +137,22 @@ def _update_impl(state, block, metric: str, packed: bool,
     colsum = af.sum(axis=0)
     n = a.shape[0]
     tr = state["trace"] + (af * af).sum() - (colsum * colsum).sum() / n
-    return {"y": y, "qc": qc, "trace": tr, "nvar": state["nvar"] + kept}
+    # Column mass S @ 1 = A_b (A_b^T 1): the same (N, v) x (v,) shape as
+    # the sketch's second matmul, so under a multi-device plan XLA
+    # inserts the identical per-block psum over the variant shards.
+    cm = state["cm"] + jax.lax.dot_general(
+        af, colsum, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return {"y": y, "qc": qc, "trace": tr,
+            "nvar": state["nvar"] + kept, "cm": cm}
 
 
 @lru_cache(maxsize=64)
 def _jitted_update(plan: GramPlan, metric: str, packed: bool,
                    grm_precise: bool):
     repl = meshes.replicated(plan.mesh)
-    state_sh = {"y": repl, "qc": repl, "trace": repl, "nvar": repl}
+    state_sh = {k: repl for k in STATE_LEAVES}
     return jax.jit(
         partial(_update_impl, metric=metric, packed=packed,
                 grm_precise=grm_precise),
@@ -184,18 +199,22 @@ def init_state(plan: GramPlan, n: int, rank: int, seed: int) -> dict:
         "qc": jax.device_put(qc, repl),
         "trace": jax.device_put(jnp.zeros((), jnp.float32), repl),
         "nvar": jax.device_put(jnp.zeros((), jnp.float32), repl),
+        "cm": jax.device_put(jnp.zeros((n,), jnp.float32), repl),
     }
 
 
 def reset_for_pass(plan: GramPlan, state: dict, qc: jnp.ndarray) -> dict:
     """Fresh accumulators for the next streamed pass, tracking ``qc``
-    (the orthonormalized subspace the corrected rung iterates)."""
+    (the orthonormalized subspace the corrected rung iterates). ``cm``
+    re-accumulates to the identical value every pass (it never depends
+    on qc), so zeroing keeps the leaf pass-local and resumable."""
     repl = meshes.replicated(plan.mesh)
     return {
         "y": jax.device_put(jnp.zeros_like(state["y"]), repl),
         "qc": jax.device_put(qc, repl),
         "trace": jax.device_put(jnp.zeros((), jnp.float32), repl),
         "nvar": jax.device_put(jnp.zeros((), jnp.float32), repl),
+        "cm": jax.device_put(jnp.zeros_like(state["cm"]), repl),
     }
 
 
@@ -269,6 +288,24 @@ def _dual_update_impl(state, block, metric: str, packed: bool,
         for (l, r, w) in spec.den_terms:
             d = d + w * (ops[l] * ops[r]).sum(axis=1)
 
+    # Scaled column mass NUM @ u (u = 1/a): the factorized model's
+    # centering colmean/grand come from this (NUM is symmetric for the
+    # registered ratio metrics, so NUM^T u = NUM u). Streams only once
+    # the scale exists — i.e. on the corrected rung's power passes.
+    cm = state["cm"]
+    if not with_den:
+        u = 1.0 / state["scale"]
+        for (l, r, w) in spec.num_terms:
+            ru = jax.lax.dot_general(
+                ops[r], u, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            contrib = jax.lax.dot_general(
+                ops[l], ru, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            cm = cm + (contrib * w if w != 1.0 else contrib)
+
     return {
         "y": apply(spec.num_terms, state["y"]),
         "yd": (apply(spec.den_terms, state["yd"]) if with_den
@@ -277,6 +314,7 @@ def _dual_update_impl(state, block, metric: str, packed: bool,
         "q": state["q"],
         "qc": qc,
         "scale": state["scale"],
+        "cm": cm,
     }
 
 
@@ -350,6 +388,7 @@ def init_dual_state(plan: GramPlan, n: int, rank: int, seed: int) -> dict:
         "q": jax.device_put(qc, repl),
         "qc": jax.device_put(np.array(qc), repl),
         "scale": jax.device_put(jnp.ones((n,), jnp.float32), repl),
+        "cm": jax.device_put(jnp.zeros((n,), jnp.float32), repl),
     }
 
 
@@ -408,23 +447,67 @@ def dual_apply(state: dict):
 def reset_dual_pass(plan: GramPlan, state: dict, q_next) -> dict:
     """Fresh sketches for the next streamed pass: track the orthonormal
     basis ``q_next`` and stream against ``q_next / a`` so the pass
-    computes NUM @ (diag(1/a) q) — the inner half of B's matvec."""
+    computes NUM @ (diag(1/a) q) — the inner half of B's matvec.
+
+    ``d`` is CARRIED, not zeroed: passes >= 1 never touch it (with_den
+    is False), and the saved model's query-side scale floor is
+    finalized from it — zeroing would lose the floor on a run that
+    resumed past pass 0. ``cm`` re-accumulates to the identical value
+    on every scaled pass (it depends only on the fixed scale), so
+    zeroing keeps it pass-local and resumable."""
     repl = meshes.replicated(plan.mesh)
     return {
         "y": jax.device_put(jnp.zeros_like(state["y"]), repl),
         "yd": jax.device_put(jnp.zeros_like(state["yd"]), repl),
-        "d": jax.device_put(jnp.zeros_like(state["d"]), repl),
+        "d": state["d"],
         "q": jax.device_put(q_next, repl),
         "qc": jax.device_put(q_next / state["scale"][:, None], repl),
         "scale": state["scale"],
+        "cm": jax.device_put(jnp.zeros_like(state["cm"]), repl),
     }
+
+
+def factor_centering(state: dict) -> tuple[np.ndarray, float]:
+    """Completed-pass factor-sketch state -> (colmean, grand): the
+    double-centering statistics of S = A A^T the factorized model
+    serves projection with, finalized on host in f64 from the streamed
+    column mass ``cm = S @ 1``. Identical formula to the exact route's
+    dense stats (colmean_j = (1/N) sum_i S_ij; grand = mean(S)) — the
+    projection path downstream is shared, bit for bit."""
+    cm = np.asarray(state["cm"], dtype=np.float64)
+    n = cm.shape[0]
+    return (cm / n).astype(np.float32), float(cm.sum() / (n * n))
+
+
+def dual_centering(state: dict) -> tuple[np.ndarray, float, float]:
+    """Completed-pass dual state -> (colmean, grand, scale_floor) of
+    the SCALED similarity s~_ij = NUM_ij / (a_i a_j), whose diagonal is
+    pinned at 1 — so the served Gower centering needs no dense
+    diagonal. From cm = NUM @ u (u = 1/a, symmetric NUM):
+
+        colmean_j = (1/N) sum_i s~_ij = u_j cm_j / N
+        grand     = (1/N^2) u^T NUM u = (1/N^2) sum_j u_j cm_j
+
+    ``scale_floor`` re-derives the :func:`_dual_scale_impl` floor from
+    the carried exact diagonal ``d`` so query-side scales are floored
+    by the same rule the fit applied."""
+    cm = np.asarray(state["cm"], dtype=np.float64)
+    a = np.asarray(state["scale"], dtype=np.float64)
+    d = np.asarray(state["d"], dtype=np.float64)
+    n = cm.shape[0]
+    u = cm / a
+    colmean = (u / n).astype(np.float32)
+    grand = float(u.sum() / (n * n))
+    a_raw = np.sqrt(np.maximum(d, 0.0))
+    floor = 1e-3 * max(float(a_raw.mean()), 1e-30)
+    return colmean, grand, floor
 
 
 def dual_state_bytes(n: int, rank: int) -> int:
     """Peak dual-solver state residency: four (N, r) f32 leaves plus
-    the (N,) diagonal and scale vectors."""
+    the (N,) diagonal, scale, and column-mass vectors."""
     r = min(rank, n)
-    return (4 * n * r + 2 * n) * 4
+    return (4 * n * r + 3 * n) * 4
 
 
 def dual_flops_per_block(n: int, v: int, rank: int, metric: str,
@@ -440,11 +523,12 @@ def dual_flops_per_block(n: int, v: int, rank: int, metric: str,
 
 
 def state_bytes(n: int, rank: int) -> int:
-    """Peak solver-state residency: y + qc f32 leaves (the scalars are
-    noise). THE 'peak solver memory' number bench reports — compare
-    against nxn_bytes(...) for what the dense route would have held."""
+    """Peak solver-state residency: y + qc f32 leaves plus the (N,)
+    column-mass vector (the scalars are noise). THE 'peak solver
+    memory' number bench reports — compare against nxn_bytes(...) for
+    what the dense route would have held."""
     r = min(rank, n)
-    return 2 * n * r * 4
+    return (2 * n * r + n) * 4
 
 
 def nxn_bytes(n: int, metric: str) -> int:

@@ -1,0 +1,298 @@
+"""Servable sketch models (models/factorized.py + the factorized
+projection paths): save/load round-trip and the ModelFormatError
+ladder, rung-carrying fingerprints, offline/served bit-identity for
+both families, and THE PR-19 acceptance chain — a corrected-rung dual
+model fitted AND served with every dense N x N allocation site rigged
+to explode, through a fleet route whose panel exceeds the pool budget
+(>= 2 staged shards per request), bit-identical to the offline
+`project` path including immediately after the sharded route's
+transient charges evict a co-resident warm panel.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+from types import SimpleNamespace
+
+from spark_examples_tpu.core import telemetry
+from spark_examples_tpu.core.config import (
+    ComputeConfig,
+    IngestConfig,
+    JobConfig,
+    ServeConfig,
+)
+from spark_examples_tpu.ingest.source import ArraySource
+from spark_examples_tpu.models.factorized import FactorizedModel
+from spark_examples_tpu.pipelines.jobs import pcoa_job, variants_pca_job
+from spark_examples_tpu.pipelines.project import (
+    ModelFormatError,
+    load_model,
+    pcoa_project_job,
+)
+from spark_examples_tpu.serve import (
+    FleetManifest,
+    ProjectionEngine,
+    ProjectionServer,
+    build_fleet,
+)
+from tests.conftest import random_genotypes
+
+N = 48
+V_BIG, V_WARM = 2048, 512   # big panel shard-stages; warm panel fits
+BV = 256
+K, RANK, ITERS = 4, 24, 2
+BIG_PANEL = N * V_BIG       # 98304 dense int8 bytes
+WARM_PANEL = N * V_WARM     # 24576
+BUDGET = 40_000             # warm fits; big needs ceil(98304/36864)=3 shards
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure(dir=None)
+
+
+def _boom(*a, **k):
+    raise AssertionError("N x N allocated on the factorized path")
+
+
+def _rig_nxn(mp):
+    """Rig every dense N x N allocation site to explode (the idiom of
+    test_solvers.test_no_nxn_on_the_sketch_path)."""
+    from spark_examples_tpu.ops import distances, gram
+    from spark_examples_tpu.parallel import gram_sharded
+
+    mp.setattr(gram_sharded, "init_sharded", _boom)
+    mp.setattr(gram, "init", _boom)
+    mp.setattr(distances, "finalize", _boom)
+
+
+@pytest.fixture(scope="module")
+def fx(tmp_path_factory):
+    """Two factorized fits — a corrected-rung dual (pcoa/ibs) model on
+    the big panel, FITTED UNDER THE N x N RIG, and a corrected-rung
+    pca-family model on the warm panel — plus compacted stores."""
+    from spark_examples_tpu.store.writer import compact
+
+    base = tmp_path_factory.mktemp("factorized_fixture")
+    rng = np.random.default_rng(19)
+    routes = {}
+    specs = [
+        ("r-big", "pcoa", "ibs", V_BIG),
+        ("r-warm", "pca", None, V_WARM),
+    ]
+    mp = pytest.MonkeyPatch()
+    _rig_nxn(mp)
+    try:
+        for i, (name, kind, metric, v) in enumerate(specs):
+            g = random_genotypes(rng, n=N, v=v, missing_rate=0.1)
+            store = str(base / f"store_{i}")
+            compact(store, ArraySource(g), chunk_variants=BV)
+            model = str(base / f"model_{i}.npz")
+            job = JobConfig(
+                ingest=IngestConfig(block_variants=BV),
+                compute=ComputeConfig(metric=metric, num_pc=K,
+                                      solver="corrected",
+                                      sketch_rank=RANK,
+                                      sketch_iters=ITERS),
+                model_path=model,
+            )
+            out = (pcoa_job if kind == "pcoa" else variants_pca_job)(
+                job, source=ArraySource(g))
+            routes[name] = SimpleNamespace(
+                name=name, genotypes=g, store=store, model=model,
+                job=job, coords=np.asarray(out.coords))
+    finally:
+        mp.undo()
+    return SimpleNamespace(base=base, routes=routes)
+
+
+def _offline(route, query) -> np.ndarray:
+    """The offline single-query `project` path — the serving
+    contract's ground truth (single row: the same jitted finalize
+    shape the server runs)."""
+    return pcoa_project_job(
+        route.job.replace(model_path=None), model_path=route.model,
+        source_new=ArraySource(
+            query[None, :] if query.ndim == 1 else query),
+        source_ref=ArraySource(route.genotypes),
+    ).coords
+
+
+# --------------------------------------------- artifact round-trip
+
+
+def test_roundtrip_and_digest_carries_rung(fx):
+    """Both families load back as validated FactorizedModels, and the
+    fingerprint hashes the RUNG PROVENANCE: two fits differing only in
+    solver, rank, or probe seed can never share a digest (and so never
+    a serving result-cache namespace)."""
+    big = load_model(fx.routes["r-big"].model)
+    assert isinstance(big, FactorizedModel)
+    assert (big.kind, big.family, big.metric) == (
+        "factorized", "pcoa", "ibs")
+    assert (big.solver, big.rank) == ("corrected", RANK)
+    assert big.n_ref == N and len(big.sample_ids) == N
+    assert big.scale is not None and big.scale.shape == (N,)
+    assert big.colmean.shape == (N,)
+    assert big.eigvecs.shape[0] == N
+    assert big.eigvecs.shape[1] == big.eigvals.shape[0] <= K
+
+    warm = load_model(fx.routes["r-warm"].model)
+    assert (warm.kind, warm.family) == ("factorized", "pca")
+    assert warm.scale is None
+
+    d = big.digest()
+    assert len(d) == 16 and set(d) <= set("0123456789abcdef")
+    assert dataclasses.replace(big, solver="sketch").digest() != d
+    assert dataclasses.replace(big, rank=RANK + 8).digest() != d
+    assert dataclasses.replace(big, seed=big.seed + 1).digest() != d
+    # Reload is stable: the digest is a pure content fingerprint.
+    assert load_model(fx.routes["r-big"].model).digest() == d
+
+
+def test_model_format_error_ladder(fx, tmp_path):
+    """Factorized-specific rungs of load_model's error ladder: unknown
+    family and missing required fields (incl. the pcoa-only scale
+    diagonal) are named ModelFormatErrors, never raw KeyErrors."""
+    with np.load(fx.routes["r-big"].model, allow_pickle=False) as z:
+        payload = {k: z[k] for k in z.files}
+
+    bad = str(tmp_path / "family.npz")
+    np.savez(bad, **{**payload, "family": np.asarray("zca")})
+    with pytest.raises(ModelFormatError, match="unknown factorized family"):
+        load_model(bad)
+
+    bad = str(tmp_path / "truncated.npz")
+    np.savez(bad, **{k: v for k, v in payload.items() if k != "colmean"})
+    with pytest.raises(ModelFormatError,
+                       match=r"missing required field\(s\).*colmean"):
+        load_model(bad)
+
+    bad = str(tmp_path / "noscale.npz")
+    np.savez(bad, **{k: v for k, v in payload.items() if k != "scale"})
+    with pytest.raises(ModelFormatError,
+                       match=r"missing required field\(s\).*scale"):
+        load_model(bad)
+
+
+def test_pca_sketch_rung_is_savable(fx, tmp_path):
+    """The single-pass sketch rung is savable for pca-family metrics
+    (no correction pass needed for the factor form) — and the saved
+    artifact records that rung."""
+    r = fx.routes["r-warm"]
+    model = str(tmp_path / "sketch.npz")
+    variants_pca_job(
+        r.job.replace(
+            model_path=model,
+            compute=dataclasses.replace(r.job.compute, solver="sketch",
+                                        sketch_iters=1)),
+        source=ArraySource(r.genotypes))
+    mdl = load_model(model)
+    assert (mdl.kind, mdl.family, mdl.solver) == (
+        "factorized", "pca", "sketch")
+    # Different rung over the same cohort: different namespace.
+    assert mdl.digest() != load_model(r.model).digest()
+
+
+# ------------------------------------------- serving bit-identity
+
+
+def test_single_server_bit_identity_both_families(fx, monkeypatch):
+    """Each factorized model served through its own ProjectionServer
+    answers bit-identically to the offline single-query `project`
+    path — with the N x N sites rigged the whole time."""
+    _rig_nxn(monkeypatch)
+    rng = np.random.default_rng(23)
+    for route in fx.routes.values():
+        v = route.genotypes.shape[1]
+        q = random_genotypes(rng, n=1, v=v, missing_rate=0.1)[0]
+        offline = _offline(route, q)
+        engine = ProjectionEngine(
+            route.model, ArraySource(route.genotypes),
+            block_variants=BV, max_batch=4)
+        with ProjectionServer(engine, cache_entries=0) as srv:
+            np.testing.assert_array_equal(
+                srv.project(q, timeout=60), offline)
+
+
+def test_acceptance_corrected_model_sharded_fleet(fx, monkeypatch):
+    """THE PR-19 acceptance chain: the corrected-rung dual model —
+    N x N sites rigged to explode for the entire serving session —
+    routes through a fleet whose pool budget is smaller than its panel,
+    so every request shard-stages (>= 2 shards observed via the
+    fleet.shard_stages counter), answers bit-identical to the offline
+    `project` path, the sharded route's transient charges evict the
+    co-resident warm panel (whose first post-eviction answer is also
+    bit-identical after re-stage), the rung-carrying fingerprint is the
+    route's cache namespace, and the transient accounting drains to
+    zero."""
+    _rig_nxn(monkeypatch)
+    big, warm = fx.routes["r-big"], fx.routes["r-warm"]
+    manifest = FleetManifest.parse({
+        "routes": [{"name": r.name, "model": r.model,
+                    "source": f"store:{r.store}"} for r in (big, warm)],
+        "budget_mb": BUDGET / 1e6,
+    })
+    fleet = build_fleet(
+        manifest, ServeConfig(cache_entries=0),
+        ingest_defaults=IngestConfig(block_variants=BV)).start()
+    rng = np.random.default_rng(29)
+    try:
+        # The router chose sharded serving from the size hint alone.
+        route = fleet.routes["r-big"]
+        assert route.panel_bytes_hint == BIG_PANEL > BUDGET
+        # Rung in the fingerprint/namespace: the cache namespace IS the
+        # digest that hashes solver/rank/seed (test_roundtrip proves
+        # the digest moves when the rung does).
+        mdl = load_model(big.model)
+        assert (mdl.solver, mdl.rank) == ("corrected", RANK)
+        assert route.cache_ns == mdl.digest()
+
+        # Warm route stages whole (it fits) and stays resident.
+        qw = random_genotypes(rng, n=1, v=V_WARM, missing_rate=0.1)[0]
+        np.testing.assert_array_equal(
+            fleet.project("r-warm", qw, timeout=60), _offline(warm, qw))
+        assert fleet.pool.is_staged("r-warm")
+
+        c0 = telemetry.counter_value("fleet.shard_stages")
+        qb = random_genotypes(rng, n=1, v=V_BIG, missing_rate=0.1)[0]
+        np.testing.assert_array_equal(
+            fleet.project("r-big", qb, timeout=60), _offline(big, qb))
+        c1 = telemetry.counter_value("fleet.shard_stages")
+        assert c1 - c0 >= 2, (c0, c1)
+        gx = telemetry.metrics_snapshot()["gauges"][
+            "fleet.panel_over_budget_x"]
+        assert gx["last"] == pytest.approx(BIG_PANEL / BUDGET)
+        assert gx["last"] > 1.0
+
+        # The shards' transient budget charges evicted the warm panel
+        # (shards themselves are never eviction candidates)...
+        assert not fleet.pool.is_staged("r-big")
+        assert not fleet.pool.is_staged("r-warm")
+        assert telemetry.counter_value("fleet.evictions") >= 1
+        # ... and the warm route's first post-eviction answer is
+        # bit-identical after the re-stage.
+        qw = random_genotypes(rng, n=1, v=V_WARM, missing_rate=0.1)[0]
+        np.testing.assert_array_equal(
+            fleet.project("r-warm", qw, timeout=60), _offline(warm, qw))
+        assert telemetry.counter_value("fleet.restage_total") >= 1
+
+        # Over-budget panels have no warm tier: a second request
+        # re-streams the shard sequence and still answers identically.
+        qb = random_genotypes(rng, n=1, v=V_BIG, missing_rate=0.1)[0]
+        np.testing.assert_array_equal(
+            fleet.project("r-big", qb, timeout=60), _offline(big, qb))
+        c2 = telemetry.counter_value("fleet.shard_stages")
+        assert c2 - c1 >= 2, (c1, c2)
+        assert fleet.routes["r-big"].tally["stages"] >= 2
+
+        st = fleet.pool.stats()
+        assert st["transient_bytes"] == 0, st
+        assert st["resident_bytes"] <= BUDGET
+        assert fleet.drain(timeout=60)
+    finally:
+        fleet.close()

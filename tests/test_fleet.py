@@ -553,11 +553,11 @@ def test_panel_pool_unit_semantics():
     assert telemetry.counter_value("fleet.evictions") == 1
     pool.acquire("b", stage(400))  # b again: restage counted
     assert telemetry.counter_value("fleet.restage_total") == 1
-    with pytest.warns(RuntimeWarning, match="exceeds the pool budget"):
+    with pytest.warns(RuntimeWarning, match="exceed the pool budget"):
         pool.acquire("huge", stage(5000))
     assert pool.is_staged("huge")  # served unevictable, loudly
     pool.remove("huge")
-    with pytest.warns(RuntimeWarning, match="exceeds the pool budget"):
+    with pytest.warns(RuntimeWarning, match="exceed the pool budget"):
         pool.acquire("huge", stage(5000))
     # remove() forgot the history: that was a first stage, not a
     # restage.

@@ -162,6 +162,68 @@ def test_sketch_kill_resume_bit_identical(packed_store, sketch_clean,
         assert f.read() == sketch_clean
 
 
+# --------------------------------------- sketch-saved model artifact
+
+
+def _model_cmd(store, out, model, ckpt):
+    # The dual corrected rung — the one whose centering stats + scale
+    # diagonal ride the SAME streamed passes the kill lands in and are
+    # saved into the FactorizedModel artifact.
+    return [sys.executable, "-m", "spark_examples_tpu", "pcoa",
+            "--source", "packed", "--path", store,
+            "--block-variants", "128", "--metric", "ibs",
+            "--solver", "corrected", "--sketch-rank", "12",
+            "--sketch-iters", "1", "--num-pc", "3",
+            "--save-model", model,
+            "--checkpoint-dir", ckpt, "--checkpoint-every-blocks", "2",
+            "--output-path", out]
+
+
+@pytest.fixture(scope="module")
+def model_clean(packed_store, tmp_path_factory):
+    store, _g = packed_store
+    d = tmp_path_factory.mktemp("model_clean")
+    out, model = str(d / "clean.tsv"), str(d / "clean_model.npz")
+    p = subprocess.run(_model_cmd(store, out, model, str(d / "ck")),
+                       env=_env(), capture_output=True, text=True,
+                       timeout=240)
+    assert p.returncode == 0, p.stderr[-2000:]
+    with open(model, "rb") as f:
+        model_bytes = f.read()
+    with open(out, "rb") as f:
+        return model_bytes, f.read()
+
+
+@pytest.mark.parametrize("kill_after", SKETCH_KILL_POINTS)
+def test_saved_model_kill_resume_byte_identical(packed_store,
+                                                model_clean, tmp_path,
+                                                kill_after):
+    """Supervised --save-model corrected run killed at the Nth block
+    read — the centering stats and dual scale diagonal are folded by
+    the same streamed passes the kill interrupts — restarts, resumes
+    from the solver checkpoint, and the saved FactorizedModel .npz
+    BYTES equal the uninterrupted run's (np.savez is byte-deterministic
+    here: fixed-header arrays, no timestamps), as do the coordinates."""
+    store, _g = packed_store
+    out = str(tmp_path / "coords.tsv")
+    model = str(tmp_path / "model.npz")
+    env = _env(**{
+        faults.ENV_SPECS:
+            f"ingest.block_read:kill:after={kill_after}:max=1",
+    })
+    cmd = _model_cmd(store, out, model, str(tmp_path / "ck")) + [
+        "--supervise"]
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "supervisor: attempt 0: crash: exit code 113" in p.stderr
+    want_model, want_coords = model_clean
+    with open(model, "rb") as f:
+        assert f.read() == want_model
+    with open(out, "rb") as f:
+        assert f.read() == want_coords
+
+
 # ------------------------------------------------- minhash neighbors job
 
 

@@ -415,7 +415,7 @@ def test_cross_accumulate_tile2d_matches_replicated(rng):
 
     def run(mode):
         plan = CrossPlan(mesh, mode)
-        acc, nv = _accumulate_cross(
+        acc, nv, _ = _accumulate_cross(
             job, ArraySource(g_new), ArraySource(g_ref), stats,
             PhaseTimer(), plan=plan,
         )

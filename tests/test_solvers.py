@@ -121,7 +121,9 @@ def test_no_nxn_on_the_sketch_path(monkeypatch):
     gauges = telemetry.metrics_snapshot()["gauges"]
     state = gauges["solver.state_bytes"]["last"]
     avoided = gauges["solver.nxn_bytes_avoided"]["last"]
-    assert state == 2 * N * RANK * 4
+    # y + qc leaves plus the (N,) streamed column-mass vector the
+    # model artifact's centering stats fold from.
+    assert state == (2 * N * RANK + N) * 4
     assert avoided == 4 * N * N  # one int32 "yy" piece for dot
     assert state < avoided
     assert gauges["solver.rung"]["last"] == 0.0
@@ -184,10 +186,11 @@ def test_sketch_guards():
     """Routes that cannot honor the sketch contract refuse loudly."""
     with pytest.raises(ValueError, match="cpu-reference|CPU"):
         pcoa_job(_job("grm", "sketch", backend="cpu-reference"))
-    job = _job("grm", "sketch")
-    job = job.replace(model_path="/tmp/nope.npz")
+    # --save-model on a rung/metric that cannot center is now rejected
+    # when the CONFIG is built (replace re-runs __post_init__), before
+    # any pass streams.
     with pytest.raises(ValueError, match="save-model|centering"):
-        pcoa_job(job)
+        _job("grm", "sketch").replace(model_path="/tmp/nope.npz")
     with pytest.raises(ValueError, match="stream"):
         from spark_examples_tpu.pipelines.streaming import (
             incremental_pcoa_job,

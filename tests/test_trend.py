@@ -101,6 +101,16 @@ def test_metric_directions_resolve_sensibly():
     assert d("neighbors_p99_ms") == trend.LOWER_IS_BETTER
     assert d("neighbors_sparse_speedup_vs_dense") == trend.HIGHER_IS_BETTER
     assert d("neighbors_ok") == trend.BOOL_MUST_HOLD
+    # Servable sketch models (bench --sketch-serve): the first shard-
+    # streamed serve and the steady p99 go DOWN via the time suffixes,
+    # the over-budget ratio is a workload descriptor (tracked, never
+    # gated), and the composite gate (bit-identity, rung in the
+    # fingerprint, >= 2 shards/request, transient charges released)
+    # must hold.
+    assert d("sketch_serve_stage_s") == trend.LOWER_IS_BETTER
+    assert d("sketch_serve_p99_ms") == trend.LOWER_IS_BETTER
+    assert d("sketch_serve_panel_over_budget_x") is None
+    assert d("sketch_serve_ok") == trend.BOOL_MUST_HOLD
 
 
 # ------------------------------------------------------------------ the band

@@ -123,6 +123,18 @@ SCENARIOS: tuple = (
     ("fleet", "fleet.stage", "io_error", dict(after=(0, 4), max=(1, 2))),
     ("fleet", "fleet.stage", "delay", dict(after=(0, 4), max=(1, 2),
                                            delay=0.01)),
+    # Shard-staged fleet rounds: ONE route whose panel exceeds the pool
+    # budget, so every request streams the panel as a multi-shard
+    # sequence through the same fleet.stage site (after >= 1 lands the
+    # fault MID-panel, between shards). An io_error fails exactly its
+    # own request — explicitly — a delay is pure latency, and after the
+    # armed window closes a full post-heal sweep must be bit-identical
+    # to the warm-pool fleet baseline (sharding is an accounting
+    # strategy, never an answer change).
+    ("fleet-sharded", "fleet.stage", "io_error",
+     dict(after=(1, 4), max=(1, 2))),
+    ("fleet-sharded", "fleet.stage", "delay",
+     dict(after=(1, 4), max=(1, 2), delay=0.01)),
     # Every gram round runs a periodic live-telemetry flusher; a flush
     # that fails must be absorbed (warned + counted) with the job —
     # and every published snapshot — intact.
@@ -367,6 +379,29 @@ class _Fixture:
                 readahead_chunks=2, store_cache_mb=4),
         )
 
+    def make_sharded_fleet(self):
+        """A fresh 1-route fleet whose panel EXCEEDS the pool budget
+        (budget = 0.4 panels), so every request serves shard-staged:
+        ~3 budget-sized shards streamed from the store per request
+        through the fleet.stage site, transient pool charges only."""
+        from spark_examples_tpu.core.config import ServeConfig
+        from spark_examples_tpu.serve import FleetManifest, build_fleet
+
+        panel_bytes = self.cfg.n_samples * self.cfg.n_variants
+        manifest = FleetManifest.parse({
+            "budget_mb": panel_bytes * 0.4 / 1e6,
+            "routes": [
+                {"name": "ibs", "model": self.model_path,
+                 "source": f"store:{self.store_dir}"},
+            ],
+        })
+        return build_fleet(
+            manifest, ServeConfig(cache_entries=0),
+            ingest_defaults=IngestConfig(
+                block_variants=self.cfg.block_variants,
+                readahead_chunks=2, store_cache_mb=4),
+        )
+
     @staticmethod
     def _close_source(src) -> None:
         for obj in (src, getattr(src, "inner", None)):
@@ -590,6 +625,83 @@ def _run_fleet_round(fx: _Fixture, spec: str,
             problems.append("fleet pool over its configured budget")
         if not fleet.drain(timeout=30.0):
             problems.append("fleet drain was not clean")
+    finally:
+        fleet.close()
+    return problems
+
+
+def _run_sharded_fleet_round(fx: _Fixture, spec: str,
+                             round_seed: int) -> list[str]:
+    """One in-process shard-staged fleet round: a 1-route fleet whose
+    panel exceeds the pool budget, so every request streams ~3 shards
+    through the armed fleet.stage site — the fault lands MID-panel,
+    between shards of a live request. Injected io_errors must fail
+    exactly their own request (explicitly — the injected error, or
+    PanelUnavailable if the route breaker tripped); delays are pure
+    latency; and once the armed window closes, a full post-heal sweep
+    must be bit-identical to the warm-pool fleet baseline, with the
+    pool back to zero transient residency."""
+    from spark_examples_tpu.serve import PanelUnavailable
+
+    problems: list[str] = []
+    fleet = fx.make_sharded_fleet()
+    injected = 0
+    stages0 = telemetry.counter_value("fleet.shard_stages")
+    try:
+        fleet.start()
+        with faults.armed([spec], seed=round_seed) as inj:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for _sweep in range(2):
+                    for qi, q in enumerate(fx.query_pool):
+                        try:
+                            got = fleet.project("ibs", q, timeout=30.0)
+                        except (faults.InjectedFault,
+                                PanelUnavailable):
+                            injected += 1
+                            continue
+                        if not np.array_equal(
+                                got, fx.fleet_baseline["ibs"][qi]):
+                            problems.append(
+                                f"sharded fleet coords for [{qi}] "
+                                "differ from the warm-pool baseline")
+            fired = inj.fire_count("fleet.stage")
+        if "io_error" in spec and injected < fired:
+            problems.append(
+                f"{fired} fleet.stage io_error(s) fired mid-panel but "
+                f"only {injected} request(s) failed with the injected "
+                "error — a shard-stage failure was swallowed")
+        if "delay" in spec and injected:
+            problems.append(
+                f"{injected} request(s) failed under a delay-only "
+                "spec — a slow shard stream must cost latency, never "
+                "correctness")
+        # Post-heal: the site is disarmed; every answer must come back
+        # bit-identical (the breaker, if tripped, never wedges the
+        # route past the armed window — failures here are violations).
+        for qi, q in enumerate(fx.query_pool):
+            try:
+                got = fleet.project("ibs", q, timeout=30.0)
+            except Exception as e:
+                problems.append(
+                    f"post-heal sharded request [{qi}] failed ({e!r}) "
+                    "— the route did not heal after the fault window")
+                continue
+            if not np.array_equal(got, fx.fleet_baseline["ibs"][qi]):
+                problems.append(
+                    f"post-heal sharded coords for [{qi}] differ from "
+                    "the warm-pool baseline")
+        if telemetry.counter_value("fleet.shard_stages") - stages0 < 2:
+            problems.append(
+                "fewer than 2 shard stages observed — the round never "
+                "actually served shard-staged")
+        st = fleet.pool.stats()
+        if st["transient_bytes"]:
+            problems.append(
+                f"{st['transient_bytes']} transient pool bytes still "
+                "charged after the round — a shard charge leaked")
+        if not fleet.drain(timeout=30.0):
+            problems.append("sharded fleet drain was not clean")
     finally:
         fleet.close()
     return problems
@@ -899,6 +1011,8 @@ def run_soak(cfg: SoakConfig) -> SoakReport:
                 problems = _run_serve_round(fx, spec, round_seed)
             elif jobkind == "fleet":
                 problems = _run_fleet_round(fx, spec, round_seed)
+            elif jobkind == "fleet-sharded":
+                problems = _run_sharded_fleet_round(fx, spec, round_seed)
             elif jobkind == "controller":
                 problems = _run_controller_round(fx, i, spec, round_seed)
             else:

@@ -112,6 +112,12 @@ _EXPLICIT: dict[str, int | None] = {
     # neighbors_p99_ms the "_ms" suffix, neighbors_ok the *_ok gate.
     "neighbors_recall_at_k": HIGHER_IS_BETTER,
     "neighbors_filter_frac": HIGHER_IS_BETTER,
+    # Servable sketch models (bench --sketch-serve): how many budgets'
+    # worth of panel the shard-staged route streams per request is a
+    # workload DESCRIPTOR (set by cohort size vs configured budget),
+    # not a quality axis — tracked, never gated. stage_s/p99_ms ride
+    # the time suffixes, sketch_serve_ok the *_ok must-hold gate.
+    "sketch_serve_panel_over_budget_x": None,
 }
 
 # (match kind, token, direction) — first hit wins, checked in order:
