@@ -610,7 +610,7 @@ def bench_kernels(store: str) -> dict:
     for name in kreg.gram_names():
         job = JobConfig(
             ingest=IngestConfig(source="packed", block_variants=BLOCK),
-            compute=ComputeConfig(metric=name),
+            compute=ComputeConfig(metric=name, gram_lowering="reference"),
         )
         run_similarity(job, source=warm)  # compile/warm at block shape
         t0 = time.perf_counter()
@@ -623,9 +623,45 @@ def bench_kernels(store: str) -> dict:
             "mb_s": round(rep.get("ingest_mb_per_s", 0.0), 1),
             "gflops": round(rep.get("gram_gflops_per_s", 0.0), 1),
         }
+        if name in kreg.fused_names():
+            # Fused column: the same slice through the packed Pallas
+            # lowering (interpret mode on CPU). fused_match is the
+            # bench-side bit-identity witness — the int32 accumulators
+            # make exact equality the contract, not a tolerance.
+            fjob = JobConfig(
+                ingest=IngestConfig(source="packed",
+                                    block_variants=BLOCK),
+                compute=ComputeConfig(metric=name,
+                                      gram_lowering="fused"),
+            )
+            run_similarity(fjob, source=warm)
+            t0 = time.perf_counter()
+            fres = run_similarity(fjob, source=source)
+            fdt = time.perf_counter() - t0
+            frep = fres.timer.report()
+            fgram = frep.get("gram", 0.0)
+            row.update({
+                "fused_total_s": round(fdt, 3),
+                "fused_gram_s": round(fgram, 3),
+                "fused_mb_s": round(frep.get("ingest_mb_per_s", 0.0),
+                                    1),
+                "fused_gflops": round(
+                    frep.get("gram_gflops_per_s", 0.0), 1),
+                "fused_speedup": round(
+                    rep.get("gram", 0.0) / fgram, 3
+                ) if fgram > 0 else 0.0,
+                "fused_match": bool(np.array_equal(
+                    np.asarray(res.similarity),
+                    np.asarray(fres.similarity))),
+            })
         out["per_kernel"][name] = row
+        extra = ""
+        if "fused_speedup" in row:
+            extra = (f", fused {row['fused_gram_s']}s "
+                     f"({row['fused_speedup']}x, match="
+                     f"{row['fused_match']})")
         log(f"kernel sweep {name}: gram {row['gram_s']}s, "
-            f"{row['mb_s']} MB/s, {row['gflops']} GFLOP/s")
+            f"{row['mb_s']} MB/s, {row['gflops']} GFLOP/s{extra}")
     return out
 
 
@@ -2867,6 +2903,28 @@ def main() -> None:
             and all(r["gflops"] > 0 and r["mb_s"] > 0
                     for r in per.values())
         )
+        # Fused-lowering gate: every fused-capable kernel must carry a
+        # fused column that matched the reference bit-exactly; the
+        # worst per-kernel speedup is the trended headline. On CPU the
+        # fused rows run the Pallas interpreter, so only parity and
+        # column presence gate; on the chip the flagship trio must
+        # actually beat the reference unpack-then-matmul path.
+        fused_rows = {k: r for k, r in per.items()
+                      if "fused_speedup" in r}
+        if fused_rows:
+            headline["kernel_fused_min_speedup"] = min(
+                r["fused_speedup"] for r in fused_rows.values())
+            fused_ok = (
+                set(fused_rows) == set(kreg.fused_names())
+                and all(r["fused_match"] and r["fused_gflops"] > 0
+                        for r in fused_rows.values())
+            )
+            if jax.default_backend() == "tpu":
+                fused_ok = fused_ok and all(
+                    fused_rows[k]["fused_speedup"] > 1.0
+                    # graftlint: disable=registry-literal  # the flagship trio the fused-kernels PR must demonstrably speed up on the chip — a deliberate highlight set, not an enumeration; the other fused kernels gate on parity above
+                    for k in ("ibs", "king", "jaccard"))
+            headline["kernel_fused_ok"] = bool(fused_ok)
 
     # Static-analysis gate: the graftlint invariant suite over the
     # production tree rides every bench headline (lint_ok must HOLD

@@ -236,6 +236,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="probe RNG seed — a resumed/supervised job "
                    "must keep it (the checkpoint records it and "
                    "rejects a mismatch)")
+    c.add_argument("--gram-lowering", default="auto",
+                   choices=list(config.GRAM_LOWERINGS),
+                   help="count-family contraction lowering: 'reference' "
+                   "= the pinned unpack-then-matmul XLA path; 'fused' = "
+                   "the packed Pallas kernel (decode + mask + contract "
+                   "in one VMEM pass — bit-identical, interpreted "
+                   "off-TPU); 'auto' = fused on TPU for fused-capable "
+                   "kernels on a packed stream, reference elsewhere")
     c.add_argument("--braycurtis-method", default="auto",
                    choices=list(config.BRAYCURTIS_METHODS),
                    help="braycurtis lowering: auto (pallas on an "
@@ -349,6 +357,7 @@ def _job_from_args(args) -> JobConfig:
             mesh_shape=mesh_shape,
             gram_mode=args.gram_mode,
             tile2d_transport=args.tile2d_transport,
+            gram_lowering=args.gram_lowering,
             eigh_mode=args.eigh_mode,
             eigh_iters=args.eigh_iters,
             eigh_oversample=args.eigh_oversample,

@@ -45,6 +45,15 @@ DEFAULT_PRIORITY = PRIORITY_CLASSES[0]
 GRAM_PLAN_MODES = ("replicated", "variant", "tile2d")
 GRAM_MODES = ("auto",) + GRAM_PLAN_MODES
 TILE2D_TRANSPORTS = ("auto", "gather", "ring")
+# Count-family gram contraction lowering (--gram-lowering): "reference"
+# = the pinned XLA path (unpack -> indicator thresholds -> int8
+# matmuls), "fused" = the packed Pallas kernel (decode + mask +
+# contract in one VMEM pass, ops/pallas/packed_gram.py — bit-identical
+# to reference for int32 accumulators, interpreted off-TPU), "auto" =
+# fused on real TPU hardware for kernels registering a fused_body on a
+# packed stream, reference everywhere else. The reference path stays
+# the oracle: parity is asserted per kernel and transport in tier-1.
+GRAM_LOWERINGS = ("auto", "reference", "fused")
 EIGH_MODES = ("auto", "dense", "randomized")
 BRAYCURTIS_METHODS = ("auto", "exact", "matmul", "pallas")
 PACK_STREAMS = ("auto", "packed", "dense")
@@ -320,6 +329,13 @@ class ComputeConfig:
     # contraction outweighs a shard hop (resolve_transport). Ignored
     # outside tile2d sharded-block plans.
     tile2d_transport: str = "auto"  # auto | gather | ring
+    # Count-family contraction lowering: "fused" runs the packed Pallas
+    # kernel (decode + mask + contract in one VMEM pass) instead of the
+    # reference unpack-then-matmul XLA path; "auto" picks fused on real
+    # TPU hardware when the kernel registers a fused_body and the
+    # stream is packed. Bit-identical either way (int32 accumulators);
+    # the reference path is the pinned oracle.
+    gram_lowering: str = "auto"  # auto | reference | fused
     eigh_mode: str = "auto"  # auto | dense | randomized
     # Randomized-solver knobs (power iterations / subspace oversample).
     # Defaults meet the documented accuracy contract (structure
@@ -409,6 +425,22 @@ class ComputeConfig:
                     "with the previous shard's contraction; auto = ring "
                     "when the kernel's FLOPs model says the contraction "
                     "hides the hop")
+        _check_enum("--gram-lowering", self.gram_lowering, GRAM_LOWERINGS,
+                    "count-family contraction lowering; reference = the "
+                    "pinned unpack-then-matmul XLA path, fused = the "
+                    "packed Pallas kernel (bit-identical), auto = fused "
+                    "on TPU for fused-capable kernels on a packed stream")
+        if self.gram_lowering == "fused":
+            # Forced fused dies at config time (flags named) when the
+            # metric/transport combination can never run it — not as a
+            # dispatch error deep inside a streaming job. "auto" never
+            # needs this: it downgrades to reference instead.
+            kern = kernels.maybe_get(self.metric or "ibs")
+            if kern is not None and kern.is_gram:
+                packed = self.pack_stream == "packed" or (
+                    self.pack_stream == "auto" and kern.pack_auto
+                )
+                kernels.check_fused_lowering(self.metric or "ibs", packed)
         _check("--sketch-rank", self.sketch_rank, 1, 65536,
                "range-sketch probe columns; clamped to N at run time")
         _check("--sketch-iters", self.sketch_iters, 0, 1000,

@@ -746,6 +746,19 @@ NAMES: dict[str, tuple[str, str]] = {
         "short for the offered load",
     ),
     # -- multi-chip execution (tile2d transports + shard-aware feed) ------
+    "gram.lowering": (
+        "gauge",
+        "count-family contraction lowering the gram job resolved to: 1 = "
+        "the fused packed Pallas kernel (decode + mask + contract in one "
+        "VMEM pass), 0 = the reference unpack-then-matmul XLA path — the "
+        "auto choice made observable (--gram-lowering)",
+    ),
+    "gram.fused_blocks": (
+        "counter",
+        "block updates dispatched through the fused packed Pallas "
+        "lowering — nonzero proves the fused kernel, not the reference "
+        "XLA path, is the one contracting (pairs with gram.lowering)",
+    ),
     "gram.ring_steps": (
         "counter",
         "tile2d ring-transport shard rotations dispatched (n_devices per "

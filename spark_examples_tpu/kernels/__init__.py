@@ -13,16 +13,19 @@ from spark_examples_tpu.kernels.base import (  # noqa: F401
     PairSpec,
     all_kernels,
     check_factorized_savable,
+    check_fused_lowering,
     check_sketchable,
     dual_sketch_names,
     factor_sketch_names,
     factorized_savable_names,
+    fused_names,
     get,
     gram_names,
     maybe_get,
     names,
     pairable_names,
     register,
+    resolve_lowering,
     unregister,
     unsketchable_metric_error,
     unsketchable_names,

@@ -168,6 +168,12 @@ class Kernel:
     max_increment: int | None = None
     value_scaled_budget: bool = False
     flops: Callable | None = None         # (n, v) -> matmul FLOPs per block
+    # Fused Pallas lowering (count family only): (packed_rows,
+    # packed_cols) -> {piece: int32 tile}, decode + mask + contract in
+    # one pass on the 2-bit bytes (ops/pallas/packed_gram.py) — the
+    # drop-in twin of slice-unpack-tile_products, bit-identical by the
+    # parity suites. None = reference XLA lowering only.
+    fused_body: Callable | None = None
     sketch: FactorSketch | DualSketch | None = None
     cross: CrossSpec | None = None
     pair: PairSpec | None = None
@@ -229,6 +235,14 @@ def register(kernel: Kernel) -> Kernel:
     if kernel.family == "table" and kernel.table_runner is None:
         raise ValueError(
             f"table kernel {kernel.name!r} declares no table_runner")
+    if kernel.fused_body is not None and not (
+            kernel.family == "count" and kernel.pack_auto):
+        raise ValueError(
+            f"kernel {kernel.name!r} declares a fused_body but is not a "
+            "pack_auto count kernel — the fused Pallas lowering consumes "
+            "2-bit packed dosage bytes, which only the dosage-defined "
+            "count family streams"
+        )
     if isinstance(kernel.sketch, DualSketch):
         declared = _dual_operand_names(kernel.sketch)
         for side in (kernel.sketch.num_terms, kernel.sketch.den_terms):
@@ -299,6 +313,50 @@ def dual_sketch_names() -> tuple[str, ...]:
     """Ratio kernels streamable as a num/den dual sketch."""
     return tuple(k.name for k in _REGISTRY.values()
                  if isinstance(k.sketch, DualSketch))
+
+
+def fused_names() -> tuple[str, ...]:
+    """Kernels with a fused packed Pallas lowering (--gram-lowering)."""
+    return tuple(k.name for k in _REGISTRY.values()
+                 if k.fused_body is not None)
+
+
+def resolve_lowering(requested: str, platform: str, fused: str,
+                     reference: str) -> str:
+    """THE auto-lowering decision, shared by every kernel family:
+    ``auto`` resolves to the fused/accelerated lowering on real TPU
+    hardware and the portable reference lowering everywhere else (the
+    Pallas interpreter is for correctness, not speed); an explicit
+    request passes through. One tiny pure function so the gram fused
+    path (ops/gram.py) and braycurtis's method pick
+    (pipelines/runner.py) can never drift — and so the decision is
+    testable without a device."""
+    if requested == "auto":
+        return fused if platform == "tpu" else reference
+    return requested
+
+
+def check_fused_lowering(metric: str, packed: bool) -> None:
+    """Raise (with the registry-derived fix named) unless ``metric`` on
+    this transport can run the fused packed Pallas lowering. The one
+    gate shared by config-time validation (core/config.py) and the
+    runtime dispatch (ops/gram.py, parallel/gram_sharded.py) — one text
+    builder, no drift."""
+    kern = _REGISTRY.get(metric)
+    if kern is None or kern.fused_body is None:
+        raise ValueError(
+            f"--gram-lowering fused does not support --metric {metric}: "
+            "no fused Pallas lowering is registered for it — fused "
+            f"kernels: {' | '.join(fused_names())}; use --gram-lowering "
+            "auto|reference for the others"
+        )
+    if not packed:
+        raise ValueError(
+            f"--gram-lowering fused consumes the 2-bit packed transport "
+            f"directly, but --metric {metric} is resolving to a dense "
+            "stream — use --pack-stream auto|packed (or --gram-lowering "
+            "auto|reference)"
+        )
 
 
 def pairable_names() -> tuple[str, ...]:

@@ -35,6 +35,23 @@ def _np_gower(sim):
     return np.sqrt(np.maximum(diag[:, None] + diag[None, :] - 2 * sim, 0.0))
 
 
+def _fused_count_body(pieces: tuple[str, ...]):
+    """The fused packed Pallas lowering for a counting kernel: decode +
+    mask + contract in one pass on the 2-bit bytes
+    (ops/pallas/packed_gram.py), bit-identical to
+    slice-unpack-``tile_products`` by the parity suites. Lazy import —
+    this closure only touches jax when a fused update actually traces."""
+
+    def fused_body(packed_rows, packed_cols):
+        from spark_examples_tpu.ops.pallas.packed_gram import (
+            fused_tile_products,
+        )
+
+        return fused_tile_products(packed_rows, packed_cols, pieces)
+
+    return fused_body
+
+
 def _count_flops(pieces: tuple[str, ...]):
     """Matmul FLOPs per block for a counting kernel: one matmul per
     int8-split term of each accumulated product (the radix-128 ``qc``
@@ -121,6 +138,7 @@ register(Kernel(
     pack_auto=True,
     max_increment=2,  # yc with y <= 2
     flops=_count_flops(("cc", "yc", "t1t1", "t2t2")),
+    fused_body=_fused_count_body(("cc", "yc", "t1t1", "t2t2")),
     # Dual sketch: similarity numerator NUM = 2m - d1 =
     # sum_v c_i c_j (2 - |a-b|) — a PSD kernel matrix per variant
     # ([[2,1,0],[1,2,1],[0,1,2]] is PSD and masking is a congruence) —
@@ -169,6 +187,7 @@ register(Kernel(
     pack_auto=True,
     max_increment=2,  # t1c-family indicator sums
     flops=_count_flops(("cc", "t1c", "t1t1", "t1t2", "t2t2")),
+    fused_body=_fused_count_body(("cc", "t1c", "t1t1", "t1t2", "t2t2")),
 ))
 
 
@@ -206,6 +225,7 @@ register(Kernel(
     pack_auto=True,
     max_increment=1,
     flops=_count_flops(("t1t1",)),
+    fused_body=_fused_count_body(("t1t1",)),
     # pca_family: the factor IS the PCA similarity (S = T1 T1^T, no
     # denominator), so a sketch-rung fit saves as a factorized PCA
     # model served with the exact route's centering formula.
@@ -339,6 +359,7 @@ register(Kernel(
     pack_auto=True,
     max_increment=2,  # finalize sums hc + hc^T / hh - 2*opp in int32
     flops=_count_flops(("t1c", "t2c", "t1t1", "t1t2", "t2t2")),
+    fused_body=_fused_count_body(("t1c", "t2c", "t1t1", "t1t2", "t2t2")),
     # No sketch spec: phi's numerator (hh - 2*opp) is indefinite AND
     # its het-count denominator is far from rank-1 (zero-het samples),
     # so neither sketch form applies — exact rung only, and the
@@ -438,6 +459,7 @@ register(Kernel(
     # per-variant increment is 2, same reason ibs2/king register 2.
     max_increment=2,
     flops=_count_flops(("t1c", "t1t1")),
+    fused_body=_fused_count_body(("t1c", "t1t1")),
     # Dual sketch: NUM = intersection counts T1 T1^T (PSD by
     # construction — both rungs available); DEN = the union pair
     # counts, whose Perron rank-1 factor the solver extracts from the
@@ -508,6 +530,8 @@ register(Kernel(
     # stays within that per-variant budget.
     max_increment=2,
     flops=_count_flops(("cc", "t1c", "t2c", "t1t1", "t1t2", "t2t2")),
+    fused_body=_fused_count_body(
+        ("cc", "t1c", "t2c", "t1t1", "t1t2", "t2t2")),
     # No sketch spec: the table is indefinite (the -1 off-diagonal
     # blocks), so neither the exact-Gram factor form nor the PSD dual
     # numerator applies — exact rung only, like king.

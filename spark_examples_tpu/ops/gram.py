@@ -83,7 +83,11 @@ def flops_per_block(n: int, v: int, metric: str) -> float:
     """Matmul FLOPs one block contributes (for GFLOPS reporting) — the
     kernel's declared FLOPs model (for counting kernels: one matmul per
     ``genotype._INT8_SPLIT`` term of each product, so euclidean is 3,
-    not 2)."""
+    not 2). ``v`` is the TRUE streamed variant span (meta.stop -
+    meta.start), not the padded device width: pad lanes — packed-byte
+    round-up, shard-grid padding — are missing calls that credit no
+    work, so reference and fused lowerings divide by the same honest
+    denominator in every throughput column."""
     kern = kernels.maybe_get(metric)
     if kern is None or kern.flops is None:
         return 2.0 * n * n * v  # one plain matmul (legacy fallback)
@@ -130,6 +134,62 @@ def _update_packed_impl(acc, packed, pieces: tuple[str, ...]):
     return _update_impl(acc, unpack_dosages(packed), pieces)
 
 
+def _update_fused_impl(acc, packed, metric: str):
+    """Fused-lowering twin of :func:`_update_packed_impl`: the kernel's
+    registered Pallas body consumes the 2-bit bytes directly (decode +
+    mask + contract in one VMEM pass — ops/pallas/packed_gram.py), so
+    no u8 dosage or indicator operand materialises in HBM. Bit-identical
+    to the reference path for the int32 accumulators (asserted per
+    kernel/transport by the tier-1 parity suites)."""
+    kern = _check_metric(metric)
+    prods = kern.fused_body(packed, packed)
+    return {k: acc[k] + prods[k] for k in kern.pieces}
+
+
+def fused_capable(metric: str, packed: bool) -> bool:
+    """Can this metric/transport pair run the fused Pallas lowering?"""
+    kern = kernels.maybe_get(metric)
+    return bool(packed and kern is not None and kern.is_gram
+                and kern.fused_body is not None)
+
+
+def resolve_gram_lowering(requested: str, metric: str, packed: bool,
+                          n_devices: int = 1,
+                          plan_mode: str = "replicated",
+                          platform: str | None = None) -> str:
+    """Resolve ``--gram-lowering`` to the lowering actually run.
+
+    ``auto`` follows the shared :func:`kernels.resolve_lowering` rule
+    (fused on real TPU hardware, reference elsewhere) and silently
+    downgrades to reference when the combination cannot run fused (no
+    registered fused_body, dense stream, or a multi-device variant-mode
+    plan — the SPMD partitioner cannot split a pallas_call, so fused
+    tiles run per device inside the tile2d shard_map only). An explicit
+    ``fused`` raises instead, naming the blocker and the fix.
+    """
+    variant_multi = plan_mode == "variant" and n_devices > 1
+    if requested == "fused":
+        kernels.check_fused_lowering(metric, packed)
+        if variant_multi:
+            raise ValueError(
+                "--gram-lowering fused runs the Pallas tile kernel per "
+                "device inside the tile2d shard_map; a multi-device "
+                "variant-mode plan partitions ONE jitted update across "
+                "chips, which cannot split a pallas_call — use "
+                "--gram-mode tile2d (or a single-device mesh), or "
+                "--gram-lowering auto|reference"
+            )
+        return "fused"
+    if platform is None:
+        platform = jax.default_backend()
+    choice = kernels.resolve_lowering(requested, platform, "fused",
+                                      "reference")
+    if choice == "fused" and (not fused_capable(metric, packed)
+                              or variant_multi):
+        return "reference"
+    return choice
+
+
 def grm_standardize(block: jnp.ndarray, precise: bool = False):
     """VanRaden standardization of one dosage block: ``(z, keep)``.
 
@@ -169,18 +229,27 @@ def _update_grm_packed_impl(acc: dict, packed, precise: bool = False) -> dict:
     return _update_grm_impl(acc, unpack_dosages(packed), precise)
 
 
-def impl_for(metric: str, packed: bool, grm_precise: bool = False):
+def impl_for(metric: str, packed: bool, grm_precise: bool = False,
+             lowering: str = "reference"):
     """The one dispatch point: unjitted ``(acc, block) -> acc`` for a
-    metric/transport pair, pieces already bound. Every jitted wrapper
-    (here and the sharded planner) derives from this.
+    metric/transport/lowering triple, pieces already bound. Every
+    jitted wrapper (here and the sharded planner) derives from this.
 
     ``grm_precise``: run the GRM's Z Z^T in f32 instead of bf16 (half
     MXU rate, ~1e-3 better relative accuracy); ignored by the exact
     integer metrics.
+
+    ``lowering``: already RESOLVED (:func:`resolve_gram_lowering`) —
+    "fused" routes the packed count-family update through the kernel's
+    registered Pallas body; float-family kernels ignore it (grm has no
+    fused lowering; auto never resolves to one for it).
     """
     kern = _check_metric(metric)
     if kern.family == "float":
         return partial(kern.update_impl(packed), precise=grm_precise)
+    if lowering == "fused":
+        kernels.check_fused_lowering(metric, packed)
+        return partial(_update_fused_impl, metric=metric)
     impl = _update_packed_impl if packed else _update_impl
     return partial(impl, pieces=kern.pieces)
 
@@ -191,6 +260,9 @@ _update = partial(jax.jit, static_argnames=("pieces",), donate_argnums=(0,))(
 _update_packed = partial(
     jax.jit, static_argnames=("pieces",), donate_argnums=(0,)
 )(_update_packed_impl)
+_update_fused = partial(
+    jax.jit, static_argnames=("metric",), donate_argnums=(0,)
+)(_update_fused_impl)
 @lru_cache(maxsize=32)
 def _float_update_jit(metric: str, packed: bool):
     """Jitted, donating convenience update for a float-family kernel —
@@ -215,6 +287,14 @@ def update_packed(acc: dict, packed: jnp.ndarray, metric: str) -> dict:
     if kern.family == "float":
         return _float_update_jit(metric, True)(acc, packed)
     return _update_packed(acc, packed, kern.pieces)
+
+
+def update_fused(acc: dict, packed: jnp.ndarray, metric: str) -> dict:
+    """Fused-lowering twin of :func:`update_packed`: the kernel's
+    registered Pallas body contracts the 2-bit bytes directly —
+    bit-identical int32 accumulators, no HBM dosage expansion."""
+    kernels.check_fused_lowering(metric, True)
+    return _update_fused(acc, packed, metric=metric)
 
 
 def combine(acc: dict, metric: str) -> dict[str, jnp.ndarray]:

@@ -1,0 +1,172 @@
+"""Fused Pallas TPU kernel for the packed count-family contraction.
+
+The reference lowering of a packed gram update is unpack-then-contract:
+``unpack_dosages`` expands the 2-bit codes into a full-width int8 dosage
+block, the indicator thresholds (ops/genotype.py) follow, and only then
+do the int8 matmuls run. Under jit the threshold math fuses, but the
+expanded block (4x the packed bytes) and each indicator operand still
+round-trip through HBM between the unpack and the MXU — on the packed
+transport, unpack bandwidth, not the MXU, bounds the count family.
+
+This kernel fuses all three stages into one ``pallas_call`` per output
+tile: the packed bytes land in VMEM once, the 2-bit decode and the
+missingness/piece indicators are formed in registers, and the int32
+tile contraction accumulates across the byte-chunk grid sweep — no u8
+dosage or indicator operand ever materialises in HBM.
+
+Bit-identity contract: the packed layout interleaves variants across
+bit planes (variant ``v`` = byte ``v // 4``, plane ``2 * (v % 4)`` —
+ingest/bitpack.py), so the kernel decodes PER PLANE and sums four
+plane-restricted int8 dots per product. Integer addition is exact under
+reordering, so the plane-summed int32 tile equals the reference
+full-width dot bit-for-bit — the same property the ring transport's
+shard-order summation relies on (parallel/gram_sharded.py). The parity
+suites (tests/test_kernel_registry.py, tests/test_parallel.py) assert
+exact equality on every transport, via the interpreter on CPU.
+
+Tiles: TI x TW packed bytes for the row block, TJ x TW for the column
+block, TI x TJ int32 output per product. TW = 512 bytes = 2048 variants
+per chunk keeps the operand VMEM footprint ~1 MB at the int8 (32, 128)
+tiling, and the worst-case 6-product output set (pc-invariant) stays
+under 384 KB of int32 tiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from spark_examples_tpu.ops.genotype import PRODUCT_OPERANDS
+
+TI = 128  # row samples per program
+TJ = 128  # column samples per program
+TW = 512  # packed bytes per chunk (4 variants each)
+
+# Operands the 2-bit decode can form in registers. qr/yr (raw values,
+# dot/euclidean) are excluded by construction: those kernels accept
+# arbitrary int8 tables the codec cannot represent (pack_auto=False).
+_PACKABLE_OPERANDS = frozenset({"c", "t1", "t2", "y"})
+
+
+def check_fusable(products: tuple[str, ...]) -> None:
+    """Raise unless every product's operands decode from 2-bit codes."""
+    for p in products:
+        ops = PRODUCT_OPERANDS.get(p)
+        if ops is None or not set(ops) <= _PACKABLE_OPERANDS:
+            raise ValueError(
+                f"product {p!r} is not lowerable by the fused packed "
+                f"kernel: its operands {ops} are not all 2-bit "
+                f"decodable ({sorted(_PACKABLE_OPERANDS)})"
+            )
+
+
+def _plane_operands(packed, shift: int, names) -> dict:
+    """Decode one bit plane's indicator operands, in registers.
+
+    ``codes = (packed >> shift) & 3`` holds every 4th variant;
+    the indicators mirror ops.genotype.operands exactly:
+    c = [code != 3] (valid), t1 = [code in {1, 2}] (alt carrier),
+    t2 = [code == 2] (hom alt), y = t1 + t2 (clipped dosage).
+    """
+    codes = (packed >> shift) & jnp.uint8(3)
+    valid = codes != jnp.uint8(3)
+    ops = {}
+    if "c" in names:
+        ops["c"] = valid.astype(jnp.int8)
+    if "t1" in names or "y" in names:
+        t1 = (valid & (codes >= jnp.uint8(1))).astype(jnp.int8)
+        if "t1" in names:
+            ops["t1"] = t1
+    if "t2" in names or "y" in names:
+        t2 = (codes == jnp.uint8(2)).astype(jnp.int8)
+        if "t2" in names:
+            ops["t2"] = t2
+    if "y" in names:
+        ops["y"] = t1 + t2
+    return ops
+
+
+def _make_kernel(products: tuple[str, ...]):
+    left = {PRODUCT_OPERANDS[p][0] for p in products}
+    right = {PRODUCT_OPERANDS[p][1] for p in products}
+
+    def kernel(rows_ref, cols_ref, *out_refs):
+        @pl.when(pl.program_id(2) == 0)
+        def _():
+            for o in out_refs:
+                o[:] = jnp.zeros_like(o)
+
+        rows = rows_ref[:]
+        cols = cols_ref[:]
+        # Four plane-restricted dots per product, summed into the int32
+        # output tile — bit-identical to the reference full-width dot
+        # (int32 addition is exact under reordering; see module doc).
+        for shift in (0, 2, 4, 6):
+            lops = _plane_operands(rows, shift, left)
+            rops = _plane_operands(cols, shift, right)
+            for p, o in zip(products, out_refs):
+                l, r = PRODUCT_OPERANDS[p]
+                o[:] += jax.lax.dot_general(
+                    lops[l], rops[r], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )
+
+    return kernel
+
+
+def fused_tile_products(
+    packed_rows: jnp.ndarray,
+    packed_cols: jnp.ndarray,
+    products: tuple[str, ...],
+    interpret: bool | None = None,
+) -> dict[str, jnp.ndarray]:
+    """Fused twin of :func:`ops.genotype.tile_products` on PACKED bytes:
+    ``(tn, W) x (tm, W) uint8 -> {product: (tn, tm) int32}``, decode +
+    mask + contract in one Pallas pass. Feeding the same slice for both
+    sides reproduces the full symmetric update.
+
+    Pads the sample axes to the (TI, TJ) program grid and the byte axis
+    to TW with 0xFF — four missing codes per byte, which decode to
+    all-zero operands and contribute nothing to any product (the same
+    semantically-free padding the whole packed transport uses); the
+    padded output rows/cols are sliced off. Not jitted here — it traces
+    inside the caller's jit (ops/gram.py) or shard_map body
+    (parallel/gram_sharded.py). ``interpret`` defaults to the Pallas
+    interpreter off-TPU (Mosaic is TPU-only), the braycurtis kernel's
+    convention, so tier-1 covers every fused kernel without hardware.
+    """
+    products = tuple(products)
+    check_fusable(products)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    pr = jnp.asarray(packed_rows, jnp.uint8)
+    pc = jnp.asarray(packed_cols, jnp.uint8)
+    (nr, w), (nc, wc) = pr.shape, pc.shape
+    if w != wc:
+        raise ValueError(
+            f"row/col packed widths disagree: {w} vs {wc} bytes"
+        )
+    nr_p = -(-nr // TI) * TI
+    nc_p = -(-nc // TJ) * TJ
+    w_p = -(-w // TW) * TW
+    pr = jnp.pad(pr, ((0, nr_p - nr), (0, w_p - w)), constant_values=0xFF)
+    pc = jnp.pad(pc, ((0, nc_p - nc), (0, w_p - w)), constant_values=0xFF)
+    outs = pl.pallas_call(
+        _make_kernel(products),
+        grid=(nr_p // TI, nc_p // TJ, w_p // TW),
+        in_specs=[
+            pl.BlockSpec((TI, TW), lambda i, j, k: (i, k)),
+            pl.BlockSpec((TJ, TW), lambda i, j, k: (j, k)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TI, TJ), lambda i, j, k: (i, j))
+            for _ in products
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nr_p, nc_p), jnp.int32)
+            for _ in products
+        ],
+        interpret=interpret,
+    )(pr, pc)
+    return {p: o[:nr, :nc] for p, o in zip(products, outs)}

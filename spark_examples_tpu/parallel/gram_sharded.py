@@ -195,7 +195,8 @@ def init_sharded(plan: GramPlan, n: int, metric: str):
 
 
 def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
-                           grm_precise: bool, transport: str):
+                           grm_precise: bool, transport: str,
+                           lowering: str = "reference"):
     """The tile2d update as an explicit shard_map, for all transports.
 
     Relying on jit + sharding annotations here lets XLA's SPMD
@@ -268,16 +269,22 @@ def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
         too, so slice-then-unpack is bit-identical to
         unpack-then-slice): per device that is (tn + tm) x v of 2-bit
         expansion instead of n x v — the full-block unpack was
-        replicated VPU work on every device. Float-family kernels
-        (GRM) need whole-chunk per-variant statistics and keep the
-        full unpack."""
+        replicated VPU work on every device. Under the fused lowering
+        the slices stay packed BYTES all the way into the Pallas body
+        (decode + mask + contract in one VMEM pass) — same tiles, same
+        int32 sums, bit-identical by the parity suites. Float-family
+        kernels (GRM) need whole-chunk per-variant statistics and keep
+        the full unpack."""
         if kern.family == "float":
             return kern.tile_body(acc, unpack(chunk), i, j, tn, tm,
                                   grm_precise)
         rows = jax.lax.dynamic_slice_in_dim(chunk, i * tn, tn, axis=0)
         cols = jax.lax.dynamic_slice_in_dim(chunk, j * tm, tm, axis=0)
-        prods = genotype.tile_products(unpack(rows), unpack(cols),
-                                       tuple(acc_specs))
+        if lowering == "fused":
+            prods = kern.fused_body(rows, cols)
+        else:
+            prods = genotype.tile_products(unpack(rows), unpack(cols),
+                                           tuple(acc_specs))
         return {k: acc[k] + prods[k] for k in acc_specs}
 
     def body(acc, block):
@@ -322,16 +329,16 @@ def _tile2d_shard_map_impl(plan: GramPlan, metric: str, packed: bool,
 @lru_cache(maxsize=64)
 def _jitted_update(plan: GramPlan, metric: str, packed: bool,
                    grm_precise: bool = False, block_layout: str = "sharded",
-                   transport: str = "gather"):
+                   transport: str = "gather", lowering: str = "reference"):
     """One jit wrapper per (plan, metric, packed, grm_precise, layout,
-    transport) — re-entering the same job shape reuses the compiled
-    executable instead of re-tracing (a fresh ``jax.jit`` object owns a
-    fresh compilation cache). The donated accumulator aliases cleanly in
-    every variant here (same leaf dtypes/shardings in and out); the
-    N x N stages whose outputs CANNOT alias their inputs live in
-    parallel/pcoa_sharded.py, which donates only the alias-able leaves
-    (tests/test_parallel.py asserts the whole sharded route compiles
-    with no unusable-donation warnings)."""
+    transport, lowering) — re-entering the same job shape reuses the
+    compiled executable instead of re-tracing (a fresh ``jax.jit``
+    object owns a fresh compilation cache). The donated accumulator
+    aliases cleanly in every variant here (same leaf dtypes/shardings
+    in and out); the N x N stages whose outputs CANNOT alias their
+    inputs live in parallel/pcoa_sharded.py, which donates only the
+    alias-able leaves (tests/test_parallel.py asserts the whole sharded
+    route compiles with no unusable-donation warnings)."""
     acc_sh = _acc_shardings(plan, metric)
     if plan.mode == "tile2d" and plan.mesh.devices.size > 1:
         sm_transport = (
@@ -339,7 +346,8 @@ def _jitted_update(plan: GramPlan, metric: str, packed: bool,
         )
         return jax.jit(
             _tile2d_shard_map_impl(plan, metric, packed, grm_precise,
-                                   transport=sm_transport),
+                                   transport=sm_transport,
+                                   lowering=lowering),
             in_shardings=(
                 acc_sh,
                 meshes.replicated(plan.mesh)
@@ -354,7 +362,7 @@ def _jitted_update(plan: GramPlan, metric: str, packed: bool,
         else plan.block_sharding
     )
     return jax.jit(
-        gram_ops.impl_for(metric, packed, grm_precise),
+        gram_ops.impl_for(metric, packed, grm_precise, lowering=lowering),
         in_shardings=(acc_sh, block_sh),
         out_shardings=acc_sh,
         donate_argnums=(0,),
@@ -417,7 +425,7 @@ def check_ring_divisible(block_width: int, plan: GramPlan,
 
 def make_update(plan: GramPlan, metric: str, packed: bool = False,
                 grm_precise: bool = False, block_layout: str = "sharded",
-                transport: str = "gather"):
+                transport: str = "gather", lowering: str = "reference"):
     """Jitted ``(acc, block) -> acc`` with the plan's shardings pinned.
 
     The computation is byte-identical to the single-chip path. Variant
@@ -449,6 +457,12 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False,
     contraction; bit-identical for int32-accumulating kernels, allclose
     for grm), or ``"auto"`` (:func:`resolve_transport` per block shape).
     Ignored outside tile2d sharded layouts.
+
+    ``lowering``: the RESOLVED count-family contraction lowering
+    (gram_ops.resolve_gram_lowering) — "fused" feeds the packed
+    row/col tile slices straight into the kernel's registered Pallas
+    body on every transport; "reference" keeps the pinned
+    unpack-then-matmul XLA path. Bit-identical either way (int32).
     """
     if block_layout not in ("sharded", "replicated"):
         raise ValueError(f"unknown block_layout {block_layout!r}")
@@ -457,6 +471,23 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False,
             f"unknown tile2d transport {transport!r}; valid: "
             f"{' | '.join(TILE2D_TRANSPORTS)}"
         )
+    if lowering not in ("reference", "fused"):
+        raise ValueError(
+            f"unresolved gram lowering {lowering!r}: make_update takes "
+            "the RESOLVED choice (reference | fused) — callers resolve "
+            "auto via gram.resolve_gram_lowering"
+        )
+    if lowering == "fused":
+        kernels.check_fused_lowering(metric, packed)
+        if plan.mode == "variant" and plan.mesh.devices.size > 1:
+            raise ValueError(
+                "--gram-lowering fused runs the Pallas tile kernel per "
+                "device inside the tile2d shard_map; a multi-device "
+                "variant-mode plan partitions ONE jitted update across "
+                "chips, which cannot split a pallas_call — use "
+                "--gram-mode tile2d (or a single-device mesh), or "
+                "--gram-lowering auto|reference"
+            )
     if block_layout == "replicated" and plan.mode == "variant":
         raise ValueError(
             "block_layout='replicated' under a variant-mode plan would "
@@ -475,7 +506,8 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False,
         transport = "gather"
         ring = False
     jitted = _jitted_update(plan, metric, packed, grm_precise, block_layout,
-                            "ring" if ring else "gather")
+                            "ring" if ring else "gather", lowering)
+    fused = lowering == "fused"
     n_shards = plan.block_shards
     n_dev = plan.mesh.devices.size
     if block_layout == "replicated":
@@ -487,6 +519,8 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False,
                 and block.sharding == want_sharding
             ):
                 block = jax.device_put(np.asarray(block), want_sharding)
+            if fused:
+                telemetry.count("gram.fused_blocks", 1)
             return jitted(acc, block)
 
         return update_replicated
@@ -513,6 +547,8 @@ def make_update(plan: GramPlan, metric: str, packed: bool = False,
             # contract): a pre-sharded jax.Array skipped the pad above.
             check_ring_divisible(block.shape[1], plan, packed)
             telemetry.count("gram.ring_steps", n_dev)
+        if fused:
+            telemetry.count("gram.fused_blocks", 1)
         if not isinstance(block, jax.Array) or (
                 block.sharding != plan.block_sharding):
             block = jax.device_put(block, plan.block_sharding)

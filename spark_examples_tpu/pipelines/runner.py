@@ -327,9 +327,20 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
             )
     else:
         transport = "gather"
+    # Contraction lowering: auto resolves to the fused packed Pallas
+    # kernel on real TPU hardware (and downgrades to reference wherever
+    # fused cannot run); an explicit --gram-lowering fused raises with
+    # the blocker named. The gauge makes the choice observable — the
+    # bench fused column and the glossary read it.
+    lowering = gram.resolve_gram_lowering(
+        cfg.gram_lowering, metric, packed,
+        n_devices=plan.mesh.devices.size, plan_mode=plan.mode,
+    )
+    telemetry.gauge_set("gram.lowering",
+                        1.0 if lowering == "fused" else 0.0)
     update = gram_sharded.make_update(
         plan, metric, packed=packed, grm_precise=cfg.grm_precise,
-        transport=transport,
+        transport=transport, lowering=lowering,
     )
 
     bv = job.ingest.block_variants
@@ -375,7 +386,13 @@ def run_gram(job: JobConfig, source, timer: PhaseTimer,
             prefetch=job.ingest.prefetch_blocks,
         ):
             acc = update(acc, block)
-            v_eff = block.shape[1] * (4 if packed else 1)
+            # FLOP credit uses the TRUE streamed variant span, not the
+            # padded device width (a ragged final block pads to the
+            # byte/shard grid with missing calls, which contribute no
+            # matmul work worth crediting) — the multihost loop already
+            # counts meta spans, and the bench fused column divides by
+            # the same honest denominator on both lowerings.
+            v_eff = meta.stop - meta.start
             timer.add("gram_flops", gram.flops_per_block(n, v_eff, metric))
             timer.add("ingest_bytes", block.size)  # bytes actually shipped
             blocks_done += 1
@@ -632,12 +649,14 @@ def _run_braycurtis(job: JobConfig, source, timer: PhaseTimer) -> SimilarityResu
             f"unknown braycurtis_method {method!r}; "
             f"valid: {' | '.join(BRAYCURTIS_METHODS)}"
         )
-    if method == "auto":
-        # Pallas is both the fastest and an exact lowering on real TPU
-        # hardware (BASELINE.md config 3: 0.33 s vs matmul 1.25 s at
-        # N=10k) — but it is a Mosaic kernel, TPU-only, so every other
-        # backend (CPU, GPU) takes the portable exact path.
-        method = "pallas" if jax.default_backend() == "tpu" else "exact"
+    # Pallas is both the fastest and an exact lowering on real TPU
+    # hardware (BASELINE.md config 3: 0.33 s vs matmul 1.25 s at N=10k)
+    # — but it is a Mosaic kernel, TPU-only, so every other backend
+    # (CPU, GPU) takes the portable exact path: the same shared
+    # auto-lowering rule the gram fused path follows.
+    method = kernels.resolve_lowering(
+        method, jax.default_backend(), fused="pallas", reference="exact"
+    )
     if job.compute.backend == "cpu-reference":
         with timer.phase("distance"):
             d = oracle.cpu_braycurtis(x)

@@ -233,19 +233,35 @@ def test_jax_and_numpy_finalize_twins_agree(genotypes, metric):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("lowering", ["reference", "fused"])
 @pytest.mark.parametrize("metric",
                          [m for m in GRAM_METRICS
                           if kernels.get(m).pack_auto])
-def test_packed_vs_dense_bit_identity(rng, metric):
+def test_packed_vs_dense_bit_identity(rng, metric, lowering):
     """--pack-stream packed and dense produce BIT-identical results
-    through the registry route for every 2-bit-packable kernel."""
+    through the registry route for every 2-bit-packable kernel — and
+    the packed leg must stay bit-identical when it runs the fused
+    Pallas lowering (interpret mode on CPU) instead of the reference
+    unpack-then-matmul path. The dense leg is always the pinned
+    reference oracle. The fused rows pin gram_mode to replicated: the
+    auto plan at this N is multi-device variant mode, which cannot
+    split a pallas_call across chips (the sharded fused coverage lives
+    in the tile2d suites)."""
+    if lowering == "fused" and metric not in kernels.fused_names():
+        pytest.skip("no fused lowering registered (float-family "
+                    "pack_auto kernel)")
     g = random_genotypes(rng, n=24, v=384, missing_rate=0.15)
+    mode = "replicated" if lowering == "fused" else "auto"
     out = {}
     for pack in ("dense", "packed"):
         out[pack] = runner.run_similarity(
             JobConfig(
                 ingest=IngestConfig(block_variants=128),
-                compute=ComputeConfig(metric=metric, pack_stream=pack),
+                compute=ComputeConfig(
+                    metric=metric, pack_stream=pack, gram_mode=mode,
+                    gram_lowering=(lowering if pack == "packed"
+                                   else "reference"),
+                ),
             ),
             source=ArraySource(g),
         )
@@ -255,21 +271,28 @@ def test_packed_vs_dense_bit_identity(rng, metric):
                                   out["packed"].distance)
 
 
+@pytest.mark.parametrize("lowering", ["reference", "fused"])
 @pytest.mark.parametrize("metric",
                          ["ibs", "ibs2", "king", "jaccard",
                           "pc-invariant"])
-def test_tile2d_multi_device_matches_replicated(rng, metric):
+def test_tile2d_multi_device_matches_replicated(rng, metric, lowering):
     """Counting kernels are integer-exact, so the tile2d plan over the
     8 virtual devices must match the replicated single-accumulator plan
     BIT-identically — the registry's sharding declarations ride the
-    same machinery for old and new kernels alike."""
+    same machinery for old and new kernels alike, and the tile2d leg
+    must agree whether its per-device contraction runs the reference
+    tile body or the fused packed Pallas kernel."""
     g = random_genotypes(rng, n=48, v=512, missing_rate=0.1)
     out = {}
     for mode in ("replicated", "tile2d"):
         out[mode] = runner.run_similarity(
             JobConfig(
                 ingest=IngestConfig(block_variants=128),
-                compute=ComputeConfig(metric=metric, gram_mode=mode),
+                compute=ComputeConfig(
+                    metric=metric, gram_mode=mode,
+                    gram_lowering=(lowering if mode == "tile2d"
+                                   else "reference"),
+                ),
             ),
             source=ArraySource(g),
         )
@@ -294,6 +317,140 @@ def test_grm_tile2d_matches_replicated(rng):
     np.testing.assert_allclose(out["replicated"].similarity,
                                out["tile2d"].similarity,
                                rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- fused lowering seam
+
+
+def test_fused_names_are_the_packable_count_family():
+    """The fused set is derived from the registry, not hand-listed:
+    exactly the pack_auto count kernels declare a fused_body (the 2-bit
+    packed transport is what the fused Pallas kernel decodes)."""
+    assert set(kernels.fused_names()) == {
+        m for m in GRAM_METRICS
+        if kernels.get(m).family == "count" and kernels.get(m).pack_auto
+    }
+    assert "grm" not in kernels.fused_names()
+    assert "dot" not in kernels.fused_names()
+
+
+def test_fused_tile_products_matches_reference_on_ragged_tiles(rng):
+    """Direct parity of the Pallas kernel (interpret mode) against
+    genotype.tile_products on shapes that exercise every pad path:
+    sample counts off the 128 tile, byte widths off the 512 tile, and
+    asymmetric row/col operands."""
+    from spark_examples_tpu.ingest import bitpack
+    from spark_examples_tpu.ops import genotype
+    from spark_examples_tpu.ops.pallas import packed_gram
+
+    rows = random_genotypes(rng, n=37, v=204, missing_rate=0.2)
+    cols = random_genotypes(rng, n=21, v=204, missing_rate=0.2)
+    prow, pcol = bitpack.pack_dosages(rows), bitpack.pack_dosages(cols)
+    for metric in kernels.fused_names():
+        pieces = kernels.get(metric).pieces
+        fused = packed_gram.fused_tile_products(prow, pcol, pieces)
+        ref = genotype.tile_products(bitpack.unpack_dosages(prow),
+                                     bitpack.unpack_dosages(pcol),
+                                     pieces)
+        for p in pieces:
+            got = np.asarray(fused[p])
+            assert got.dtype == np.int32
+            np.testing.assert_array_equal(got, np.asarray(ref[p]),
+                                          err_msg=f"{metric}/{p}")
+
+
+def test_fused_rejects_undecodable_pieces():
+    """Only operands decodable from a 2-bit code can feed the fused
+    kernel — the centered/weighted operands (grm's z, the dual
+    sketches' q) have no packed representation."""
+    from spark_examples_tpu.ops.pallas import packed_gram
+
+    with pytest.raises(ValueError, match="qc"):
+        packed_gram.check_fusable(("t1t1", "qc"))
+
+
+def test_resolve_lowering_is_the_shared_auto_helper():
+    """One helper owns every backend-conditional lowering pick: the
+    gram family's auto choice AND braycurtis's pallas/exact method ride
+    the same function, so 'fused on TPU, reference elsewhere' can never
+    drift between subsystems."""
+    assert kernels.resolve_lowering(
+        "auto", "tpu", "fused", "reference") == "fused"
+    assert kernels.resolve_lowering(
+        "auto", "cpu", "fused", "reference") == "reference"
+    # explicit choices pass through untouched on any backend
+    assert kernels.resolve_lowering(
+        "fused", "cpu", "fused", "reference") == "fused"
+    assert kernels.resolve_lowering(
+        "reference", "tpu", "fused", "reference") == "reference"
+    # the braycurtis fold: same helper, its own option names
+    assert kernels.resolve_lowering(
+        "auto", "tpu", "pallas", "exact") == "pallas"
+    assert kernels.resolve_lowering(
+        "exact", "tpu", "pallas", "exact") == "exact"
+
+
+def test_resolve_gram_lowering_downgrades_and_gates():
+    """auto resolves to fused only where fused can run (TPU platform,
+    fused-capable kernel, packed stream, and a plan whose per-device
+    update can host a pallas_call); forced fused raises with the flags
+    named instead of silently downgrading."""
+    assert gram.resolve_gram_lowering(
+        "auto", "ibs", True, platform="tpu") == "fused"
+    assert gram.resolve_gram_lowering(
+        "auto", "ibs", True, platform="cpu") == "reference"
+    assert gram.resolve_gram_lowering(
+        "auto", "grm", False, platform="tpu") == "reference"
+    # a multi-device variant-mode plan partitions ONE jitted update
+    # across chips — XLA cannot split the pallas_call, so auto
+    # downgrades and forced fused refuses, naming the tile2d fix.
+    assert gram.resolve_gram_lowering(
+        "auto", "ibs", True, n_devices=8, plan_mode="variant",
+        platform="tpu") == "reference"
+    with pytest.raises(ValueError, match="tile2d"):
+        gram.resolve_gram_lowering(
+            "fused", "ibs", True, n_devices=8, plan_mode="variant")
+    # forced fused on a capable single-device plan holds anywhere
+    # (CPU runs the Pallas interpreter)
+    assert gram.resolve_gram_lowering("fused", "ibs", True) == "fused"
+
+
+def test_check_fused_lowering_names_flags():
+    with pytest.raises(ValueError, match=r"--gram-lowering fused"):
+        kernels.check_fused_lowering("grm", True)
+    with pytest.raises(ValueError, match=r"--pack-stream"):
+        kernels.check_fused_lowering("ibs", False)
+    kernels.check_fused_lowering("ibs", True)  # capable combo passes
+
+
+def test_config_validates_gram_lowering():
+    """Config-time gate: the same check_fused_lowering text fires from
+    ComputeConfig.__post_init__, so an impossible --gram-lowering fused
+    job dies at argparse time, not after ingest starts."""
+    with pytest.raises(ValueError, match=r"--gram-lowering"):
+        ComputeConfig(gram_lowering="mosaic")
+    with pytest.raises(ValueError, match=r"--gram-lowering fused"):
+        ComputeConfig(metric="grm", gram_lowering="fused")
+    with pytest.raises(ValueError, match=r"--pack-stream"):
+        ComputeConfig(metric="ibs", pack_stream="dense",
+                      gram_lowering="fused")
+    # pack_stream auto resolves packed for a pack_auto count kernel
+    ComputeConfig(metric="ibs", gram_lowering="fused")
+
+
+def test_register_rejects_fused_body_outside_count_family():
+    """The registry seam's own contract: a fused_body on anything but
+    a pack_auto count kernel is a registration error — the fused
+    lowering decodes 2-bit dosage codes, which only that family
+    streams."""
+    import dataclasses
+
+    bad = dataclasses.replace(
+        kernels.get("grm"), name="grm-fused-test",
+        fused_body=lambda rows, cols: {})
+    with pytest.raises(ValueError, match="pack_auto count"):
+        kernels.register(bad)
+    assert "grm-fused-test" not in kernels.names()
 
 
 # ------------------------------------------------------------- jaccard

@@ -372,11 +372,13 @@ def test_replicated_block_layout_rejected_for_variant_mode(mesh):
 # ------------------------------------------------------- ring transport
 
 
+@pytest.mark.parametrize("lowering", ["reference", "fused"])
 @pytest.mark.parametrize("packed", [False, True])
 @pytest.mark.parametrize(
     "metric", ["ibs", "ibs2", "king", "jaccard", "grm"]
 )
-def test_ring_transport_matches_gather(rng, mesh, metric, packed):
+def test_ring_transport_matches_gather(rng, mesh, metric, packed,
+                                       lowering):
     """The tentpole contract: the ppermute ring schedule produces the
     SAME accumulators as the bulk all_gather — BIT-identical for every
     int32-accumulating kernel (integer sums are exact under the ring's
@@ -384,32 +386,48 @@ def test_ring_transport_matches_gather(rng, mesh, metric, packed):
     at a different ring offset (device d contracts shards d, d+1, ...,
     d-1 in that order), so one pass covers all 8 offsets; the final
     ragged block additionally exercises the pad path on both
-    transports."""
+    transports. The fused axis reruns both transports with the packed
+    Pallas tile body (interpret mode on CPU) and additionally pins them
+    to the reference-lowering gather run — the checkpointed accumulator
+    contract extends across lowerings, not just transports."""
     from spark_examples_tpu.ingest import bitpack
+
+    if lowering == "fused" and not (packed and metric != "grm"):
+        pytest.skip("fused lowering decodes the 2-bit packed stream "
+                    "(count family only)")
 
     g = random_genotypes(rng, n=32, v=288, missing_rate=0.12)
     plan = gram_sharded.GramPlan(mesh, "tile2d")
-    accs = {}
-    for transport in ("gather", "ring"):
+
+    def _stream(transport, lw):
         acc = gram_sharded.init_sharded(plan, 32, metric)
         update = gram_sharded.make_update(plan, metric, packed=packed,
-                                          transport=transport)
+                                          transport=transport,
+                                          lowering=lw)
         for s in range(0, 288, 96):  # final block ragged after padding
             blk = g[:, s:s + 96]
             if packed:
                 blk = bitpack.pack_dosages(blk)
             acc = update(acc, blk)
-        accs[transport] = {k: np.asarray(v) for k, v in acc.items()}
+        return {k: np.asarray(v) for k, v in acc.items()}
+
+    accs = {t: _stream(t, lowering) for t in ("gather", "ring")}
+    if lowering == "fused":
+        # the cross-lowering oracle: fused rings/gathers must equal the
+        # reference lowering bit-exactly (int32 sums are reorder-exact)
+        accs["reference"] = _stream("gather", "reference")
     for k in accs["gather"]:
-        if metric == "grm" and k == "zz":
-            np.testing.assert_allclose(
-                accs["gather"][k], accs["ring"][k], rtol=1e-5, atol=1e-4,
-                err_msg=f"{metric}/{k}")
-        else:
-            np.testing.assert_array_equal(
-                accs["gather"][k], accs["ring"][k],
-                err_msg=f"ring transport diverged from gather on "
-                        f"{metric}/{k} (packed={packed})")
+        for other in [t for t in accs if t != "gather"]:
+            if metric == "grm" and k == "zz":
+                np.testing.assert_allclose(
+                    accs["gather"][k], accs[other][k],
+                    rtol=1e-5, atol=1e-4, err_msg=f"{metric}/{k}")
+            else:
+                np.testing.assert_array_equal(
+                    accs["gather"][k], accs[other][k],
+                    err_msg=f"{other} diverged from gather on "
+                            f"{metric}/{k} (packed={packed}, "
+                            f"lowering={lowering})")
 
 
 def test_ring_lowering_is_permute_only(mesh):
@@ -517,6 +535,56 @@ def test_ring_run_gram_checkpoint_resumes_bit_identical(rng, tmp_path):
                                   clean_gather.similarity)
 
 
+def test_cross_lowering_checkpoint_resumes_bit_identical(rng, tmp_path):
+    """Kill/resume row across the LOWERING axis: a checkpoint written
+    while streaming under one gram lowering resumes under the OTHER to
+    the same similarity as either uninterrupted run — the accumulator
+    on disk is int32 piece counts, identical bit-for-bit whichever
+    lowering produced them, so operators can flip --gram-lowering
+    mid-incident without invalidating checkpoints."""
+    from spark_examples_tpu.core.config import (
+        ComputeConfig, IngestConfig, JobConfig,
+    )
+    from spark_examples_tpu.ingest import ArraySource
+    from spark_examples_tpu.pipelines import runner
+
+    g = random_genotypes(rng, n=16, v=1024, missing_rate=0.1)
+
+    def job(lowering, ckpt=None):
+        return JobConfig(
+            ingest=IngestConfig(block_variants=128),
+            compute=ComputeConfig(
+                metric="king", gram_mode="tile2d",
+                gram_lowering=lowering,
+                checkpoint_dir=ckpt,
+                checkpoint_every_blocks=2 if ckpt else 0,
+            ),
+        )
+
+    class Dying(ArraySource):
+        def blocks(self, bv, start_variant=0):
+            for b, m in super().blocks(bv, start_variant):
+                if m.start >= 5 * 128:
+                    raise RuntimeError("simulated preemption")
+                yield b, m
+
+    clean = {lw: runner.run_similarity(job(lw), source=ArraySource(g))
+             for lw in ("reference", "fused")}
+    np.testing.assert_array_equal(clean["reference"].similarity,
+                                  clean["fused"].similarity)
+    for wrote, resumed_under in (("reference", "fused"),
+                                 ("fused", "reference")):
+        ckpt = str(tmp_path / f"ck-{wrote}")
+        with pytest.raises(RuntimeError, match="preemption"):
+            runner.run_similarity(job(wrote, ckpt), source=Dying(g))
+        out = runner.run_similarity(job(resumed_under, ckpt),
+                                    source=ArraySource(g))
+        np.testing.assert_array_equal(
+            out.similarity, clean[resumed_under].similarity,
+            err_msg=f"checkpoint written under {wrote} did not resume "
+                    f"bit-identically under {resumed_under}")
+
+
 def test_ring_update_counts_ring_steps(rng, mesh):
     from spark_examples_tpu.core import telemetry
 
@@ -526,6 +594,40 @@ def test_ring_update_counts_ring_steps(rng, mesh):
     acc = gram_sharded.init_sharded(plan, 32, "ibs")
     update(acc, random_genotypes(rng, n=32, v=64, missing_rate=0.1))
     assert telemetry.counter_value("gram.ring_steps") - before == 8
+
+
+def test_fused_update_counts_fused_blocks(rng, mesh):
+    from spark_examples_tpu.core import telemetry
+    from spark_examples_tpu.ingest import bitpack
+
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    before = telemetry.counter_value("gram.fused_blocks")
+    update = gram_sharded.make_update(plan, "ibs", packed=True,
+                                      lowering="fused")
+    acc = gram_sharded.init_sharded(plan, 32, "ibs")
+    blk = bitpack.pack_dosages(
+        random_genotypes(rng, n=32, v=64, missing_rate=0.1))
+    update(acc, blk)
+    assert telemetry.counter_value("gram.fused_blocks") - before == 1
+
+
+def test_make_update_validates_lowering(mesh):
+    """make_update takes the RESOLVED lowering only — auto must be
+    resolved by the caller (gram.resolve_gram_lowering) — and a fused
+    request that cannot run dies with the flags named: dense streams
+    have nothing to decode, and a multi-device variant-mode plan
+    cannot split one pallas_call across chips."""
+    plan = gram_sharded.GramPlan(mesh, "tile2d")
+    with pytest.raises(ValueError, match="unresolved gram lowering"):
+        gram_sharded.make_update(plan, "ibs", packed=True,
+                                 lowering="auto")
+    with pytest.raises(ValueError, match=r"--pack-stream"):
+        gram_sharded.make_update(plan, "ibs", packed=False,
+                                 lowering="fused")
+    vplan = gram_sharded.GramPlan(mesh, "variant")
+    with pytest.raises(ValueError, match="tile2d"):
+        gram_sharded.make_update(vplan, "ibs", packed=True,
+                                 lowering="fused")
 
 
 def test_sharded_route_emits_no_unusable_donation_warnings(rng, mesh):

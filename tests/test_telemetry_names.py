@@ -327,6 +327,10 @@ def test_core_names_present():
         "neighbors.bucket_overflows",
         "neighbors.evaluated_pairs",
         "neighbors.requests",
+        # fused packed gram lowering (this PR's instrumentation
+        # contract): the auto choice and its per-block evidence
+        "gram.lowering",
+        "gram.fused_blocks",
     ):
         assert name in telemetry.NAMES, name
     assert telemetry.is_declared("phase.gram")  # family resolution

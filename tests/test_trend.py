@@ -51,6 +51,12 @@ def test_metric_directions_resolve_sensibly():
     assert d("kernel_king_gflops") == trend.HIGHER_IS_BETTER
     assert d("kernel_sweep_min_gflops") == trend.HIGHER_IS_BETTER
     assert d("kernel_sweep_ok") == trend.BOOL_MUST_HOLD
+    # Fused packed lowering (the fused-kernels PR): the worst
+    # fused-vs-reference gram speedup must go UP ("speedup" matches no
+    # suffix rule — pinned explicitly), and the parity-plus-presence
+    # gate holds like every *_ok.
+    assert d("kernel_fused_min_speedup") == trend.HIGHER_IS_BETTER
+    assert d("kernel_fused_ok") == trend.BOOL_MUST_HOLD
     # Multi-chip row (bench --multichip): throughput, the d8-vs-d1
     # wall-clock scaling, and the gather-hidden-behind-compute fraction
     # all go up; the solve-stage seconds go down; the ring-identity +

@@ -118,6 +118,13 @@ _EXPLICIT: dict[str, int | None] = {
     # not a quality axis — tracked, never gated. stage_s/p99_ms ride
     # the time suffixes, sketch_serve_ok the *_ok must-hold gate.
     "sketch_serve_panel_over_budget_x": None,
+    # Fused packed gram lowering (bench --kernels): the worst
+    # per-kernel fused-vs-reference gram speedup. "speedup" alone
+    # matches no suffix rule, and this one must go UP — the whole
+    # point of decoding the 2-bit codes in-register is beating the
+    # unpack-then-matmul reference. kernel_fused_ok (parity + column
+    # presence, plus chip-only speedup floor) rides the *_ok gate.
+    "kernel_fused_min_speedup": HIGHER_IS_BETTER,
 }
 
 # (match kind, token, direction) — first hit wins, checked in order:
